@@ -1,0 +1,65 @@
+"""hyperspace_tpu — a TPU-native data-lake indexing framework.
+
+A ground-up re-design of the capabilities of Microsoft Hyperspace (the
+reference at /root/reference, Scala/Spark) for TPU hardware:
+
+* The *metadata plane* — a lake-resident, versioned operation log with
+  optimistic concurrency (reference: ``index/IndexLogManager.scala``,
+  ``index/IndexLogEntry.scala``) — is pure host Python, as it is pure JVM
+  code in the reference.
+* The *data plane* — index build (hash-bucket shuffle, sort, bucketed
+  columnar write; reference: ``index/covering/CoveringIndex.scala:56-71``)
+  and index-backed query execution (filter/join kernels) — runs on TPU as
+  XLA-compiled JAX programs: ``shard_map`` + ``lax.all_to_all`` over an ICI
+  device mesh replaces the Spark shuffle, device sort replaces
+  sort-within-bucket, and columnar filter / merge-join kernels replace
+  Spark's ``FileSourceScanExec``/SMJ.
+* The *planner* — in the reference an injected Catalyst rule
+  (``rules/ApplyHyperspace.scala``) — is here a small relational IR plus a
+  score-based optimizer that we own end to end.
+
+Public API (mirrors reference ``Hyperspace.scala:27-193`` and
+``python/hyperspace/hyperspace.py``)::
+
+    from hyperspace_tpu import HyperspaceSession, Hyperspace, CoveringIndexConfig
+
+    sess = HyperspaceSession()
+    hs = Hyperspace(sess)
+    df = sess.read.parquet("/data/t")
+    hs.create_index(df, CoveringIndexConfig("idx", ["k"], ["v"]))
+    sess.enable_hyperspace()
+    df.filter(df["k"] == 3).select("v").collect()   # served from the index
+"""
+
+from hyperspace_tpu.exceptions import HyperspaceException  # noqa: F401
+
+__version__ = "0.1.0"
+
+# Lazy top-level convenience imports (PEP 562) to avoid import cycles and
+# keep `import hyperspace_tpu` cheap (no JAX import until a session is made).
+_LAZY = {
+    "HyperspaceSession": ("hyperspace_tpu.session", "HyperspaceSession"),
+    "Hyperspace": ("hyperspace_tpu.hyperspace", "Hyperspace"),
+    "CoveringIndexConfig": ("hyperspace_tpu.indexes.covering", "CoveringIndexConfig"),
+    "IndexConfig": ("hyperspace_tpu.indexes.covering", "CoveringIndexConfig"),
+    "ZOrderCoveringIndexConfig": (
+        "hyperspace_tpu.indexes.zorder",
+        "ZOrderCoveringIndexConfig",
+    ),
+    "DataSkippingIndexConfig": (
+        "hyperspace_tpu.indexes.dataskipping",
+        "DataSkippingIndexConfig",
+    ),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod, attr = _LAZY[name]
+        return getattr(importlib.import_module(mod), attr)
+    raise AttributeError(f"module 'hyperspace_tpu' has no attribute {name!r}")
+
+
+__all__ = ["HyperspaceException", "__version__"] + sorted(_LAZY)

@@ -1,0 +1,174 @@
+"""Typed config system.
+
+Reference: ``util/HyperspaceConf.scala:27-238`` — typed accessors over flat
+string-keyed Spark SQL confs. Here the session owns a plain dict; this
+module provides the same typed accessor surface plus defaults from
+:mod:`hyperspace_tpu.constants`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from hyperspace_tpu import constants as C
+
+
+def _to_bool(v: Any) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).strip().lower() in ("1", "true", "yes", "on")
+
+
+class Config:
+    """Flat key→value config with typed accessors and change tracking.
+
+    ``version`` increments on every mutation; caches keyed on config state
+    (reference ``util/CacheWithTransform.scala``) compare it to decide
+    invalidation.
+    """
+
+    def __init__(self, initial: Optional[dict] = None):
+        self._values: dict = dict(initial or {})
+        self.version = 0
+
+    # -- raw access ---------------------------------------------------------
+    def set(self, key: str, value: Any) -> None:
+        self._values[key] = value
+        self.version += 1
+
+    def unset(self, key: str) -> None:
+        if key in self._values:
+            del self._values[key]
+            self.version += 1
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._values.get(key, default)
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        return _to_bool(self._values.get(key, default))
+
+    def get_int(self, key: str, default: int = 0) -> int:
+        return int(self._values.get(key, default))
+
+    def get_float(self, key: str, default: float = 0.0) -> float:
+        return float(self._values.get(key, default))
+
+    def get_str(self, key: str, default: str = "") -> str:
+        return str(self._values.get(key, default))
+
+    # -- typed accessors (HyperspaceConf.scala) -----------------------------
+    @property
+    def apply_enabled(self) -> bool:
+        return self.get_bool(
+            C.HYPERSPACE_APPLY_ENABLED, C.HYPERSPACE_APPLY_ENABLED_DEFAULT
+        )
+
+    @property
+    def num_buckets(self) -> int:
+        return self.get_int(C.INDEX_NUM_BUCKETS, C.INDEX_NUM_BUCKETS_DEFAULT)
+
+    @property
+    def lineage_enabled(self) -> bool:
+        return self.get_bool(
+            C.INDEX_LINEAGE_ENABLED, C.INDEX_LINEAGE_ENABLED_DEFAULT
+        )
+
+    @property
+    def hybrid_scan_enabled(self) -> bool:
+        return self.get_bool(
+            C.INDEX_HYBRID_SCAN_ENABLED, C.INDEX_HYBRID_SCAN_ENABLED_DEFAULT
+        )
+
+    @property
+    def hybrid_scan_max_appended_ratio(self) -> float:
+        return self.get_float(
+            C.INDEX_HYBRID_SCAN_MAX_APPENDED_RATIO,
+            C.INDEX_HYBRID_SCAN_MAX_APPENDED_RATIO_DEFAULT,
+        )
+
+    @property
+    def hybrid_scan_max_deleted_ratio(self) -> float:
+        return self.get_float(
+            C.INDEX_HYBRID_SCAN_MAX_DELETED_RATIO,
+            C.INDEX_HYBRID_SCAN_MAX_DELETED_RATIO_DEFAULT,
+        )
+
+    @property
+    def filter_rule_use_bucket_spec(self) -> bool:
+        return self.get_bool(
+            C.INDEX_FILTER_RULE_USE_BUCKET_SPEC,
+            C.INDEX_FILTER_RULE_USE_BUCKET_SPEC_DEFAULT,
+        )
+
+    @property
+    def optimize_file_size_threshold(self) -> int:
+        return self.get_int(
+            C.OPTIMIZE_FILE_SIZE_THRESHOLD, C.OPTIMIZE_FILE_SIZE_THRESHOLD_DEFAULT
+        )
+
+    @property
+    def cache_expiry_seconds(self) -> int:
+        return self.get_int(
+            C.INDEX_CACHE_EXPIRY_SECONDS, C.INDEX_CACHE_EXPIRY_SECONDS_DEFAULT
+        )
+
+    @property
+    def source_provider_builders(self) -> list:
+        raw = self.get_str(
+            C.INDEX_SOURCES_PROVIDERS, C.INDEX_SOURCES_PROVIDERS_DEFAULT
+        )
+        return [s.strip() for s in raw.split(",") if s.strip()]
+
+    @property
+    def default_supported_formats(self) -> set:
+        raw = self.get_str(
+            C.DEFAULT_SUPPORTED_FORMATS, C.DEFAULT_SUPPORTED_FORMATS_DEFAULT
+        )
+        return {s.strip().lower() for s in raw.split(",") if s.strip()}
+
+    @property
+    def zorder_target_source_bytes_per_partition(self) -> int:
+        return self.get_int(
+            C.ZORDER_TARGET_SOURCE_BYTES_PER_PARTITION,
+            C.ZORDER_TARGET_SOURCE_BYTES_PER_PARTITION_DEFAULT,
+        )
+
+    @property
+    def zorder_quantile_enabled(self) -> bool:
+        return self.get_bool(
+            C.ZORDER_QUANTILE_ENABLED, C.ZORDER_QUANTILE_ENABLED_DEFAULT
+        )
+
+    @property
+    def dataskipping_target_index_data_file_size(self) -> int:
+        return self.get_int(
+            C.DATASKIPPING_TARGET_INDEX_DATA_FILE_SIZE,
+            C.DATASKIPPING_TARGET_INDEX_DATA_FILE_SIZE_DEFAULT,
+        )
+
+    @property
+    def dataskipping_auto_partition_sketch(self) -> bool:
+        return self.get_bool(
+            C.DATASKIPPING_AUTO_PARTITION_SKETCH,
+            C.DATASKIPPING_AUTO_PARTITION_SKETCH_DEFAULT,
+        )
+
+
+class CacheWithTransform:
+    """Caches ``transform(conf)`` until the config is mutated.
+
+    Reference: ``util/CacheWithTransform.scala:45`` — the source-provider
+    list is rebuilt only when the backing conf value changes.
+    """
+
+    def __init__(self, conf: Config, transform: Callable[[Config], Any]):
+        self._conf = conf
+        self._transform = transform
+        self._cached = None
+        self._cached_version = -1
+
+    def load(self) -> Any:
+        if self._cached_version != self._conf.version:
+            self._cached = self._transform(self._conf)
+            self._cached_version = self._conf.version
+        return self._cached

@@ -1,0 +1,139 @@
+"""Config keys, index states and reserved property names.
+
+Reference: ``index/IndexConstants.scala:21-170`` and
+``actions/Constants.scala:20-34``. Keys drop the ``spark.`` prefix — this
+framework owns its own config system (see :mod:`hyperspace_tpu.config`).
+"""
+
+# ---------------------------------------------------------------------------
+# Index lifecycle states (actions/Constants.scala:20-34)
+# ---------------------------------------------------------------------------
+
+
+class States:
+    DOESNOTEXIST = "DOESNOTEXIST"
+    CREATING = "CREATING"
+    ACTIVE = "ACTIVE"
+    REFRESHING = "REFRESHING"
+    OPTIMIZING = "OPTIMIZING"
+    DELETING = "DELETING"
+    DELETED = "DELETED"
+    RESTORING = "RESTORING"
+    VACUUMING = "VACUUMING"
+    VACUUMINGOUTDATED = "VACUUMINGOUTDATED"
+
+    STABLE_STATES = frozenset({ACTIVE, DELETED, DOESNOTEXIST})
+
+    # transient state -> stable state it rolls back to on cancel()
+    ROLLBACK = {
+        CREATING: DOESNOTEXIST,
+        REFRESHING: ACTIVE,
+        OPTIMIZING: ACTIVE,
+        VACUUMINGOUTDATED: ACTIVE,
+        DELETING: ACTIVE,
+        RESTORING: DELETED,
+        VACUUMING: DELETED,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Config keys (index/IndexConstants.scala) — flat string keys
+# ---------------------------------------------------------------------------
+
+HYPERSPACE_APPLY_ENABLED = "hyperspace.apply.enabled"
+HYPERSPACE_APPLY_ENABLED_DEFAULT = True
+
+INDEX_SYSTEM_PATH = "hyperspace.system.path"
+
+INDEX_NUM_BUCKETS = "hyperspace.index.num_buckets"
+INDEX_NUM_BUCKETS_DEFAULT = 200  # IndexConstants.scala:33-36 (= shuffle partitions)
+
+INDEX_LINEAGE_ENABLED = "hyperspace.index.lineage.enabled"
+INDEX_LINEAGE_ENABLED_DEFAULT = False  # IndexConstants.scala:105-106
+
+INDEX_HYBRID_SCAN_ENABLED = "hyperspace.index.hybridscan.enabled"
+INDEX_HYBRID_SCAN_ENABLED_DEFAULT = False
+INDEX_HYBRID_SCAN_MAX_APPENDED_RATIO = "hyperspace.index.hybridscan.maxAppendedRatio"
+INDEX_HYBRID_SCAN_MAX_APPENDED_RATIO_DEFAULT = 0.3  # IndexConstants.scala:44-52
+INDEX_HYBRID_SCAN_MAX_DELETED_RATIO = "hyperspace.index.hybridscan.maxDeletedRatio"
+INDEX_HYBRID_SCAN_MAX_DELETED_RATIO_DEFAULT = 0.2
+
+INDEX_FILTER_RULE_USE_BUCKET_SPEC = "hyperspace.index.filterRule.useBucketSpec"
+INDEX_FILTER_RULE_USE_BUCKET_SPEC_DEFAULT = False  # IndexConstants.scala:56-57
+
+OPTIMIZE_FILE_SIZE_THRESHOLD = "hyperspace.index.optimize.fileSizeThreshold"
+OPTIMIZE_FILE_SIZE_THRESHOLD_DEFAULT = 256 * 1024 * 1024  # 256MB, :116-117
+OPTIMIZE_MODE_QUICK = "quick"
+OPTIMIZE_MODE_FULL = "full"
+OPTIMIZE_MODES = (OPTIMIZE_MODE_QUICK, OPTIMIZE_MODE_FULL)
+
+REFRESH_MODE_FULL = "full"
+REFRESH_MODE_INCREMENTAL = "incremental"
+REFRESH_MODE_QUICK = "quick"
+REFRESH_MODES = (REFRESH_MODE_FULL, REFRESH_MODE_INCREMENTAL, REFRESH_MODE_QUICK)
+
+INDEX_CACHE_EXPIRY_SECONDS = "hyperspace.index.cache.expiryDurationInSeconds"
+INDEX_CACHE_EXPIRY_SECONDS_DEFAULT = 300  # CachingIndexCollectionManager.scala
+
+INDEX_SOURCES_PROVIDERS = "hyperspace.index.sources.fileBasedBuilders"
+INDEX_SOURCES_PROVIDERS_DEFAULT = (
+    "hyperspace_tpu.sources.default.DefaultFileBasedSourceBuilder,"
+    "hyperspace_tpu.sources.delta.DeltaLakeSourceBuilder,"
+    "hyperspace_tpu.sources.iceberg.IcebergSourceBuilder"
+)
+
+DEFAULT_SUPPORTED_FORMATS = "hyperspace.index.sources.defaultSupportedFormats"
+DEFAULT_SUPPORTED_FORMATS_DEFAULT = "csv,json,parquet"
+
+# Z-order (IndexConstants.scala:59-74)
+ZORDER_TARGET_SOURCE_BYTES_PER_PARTITION = (
+    "hyperspace.index.zorder.targetSourceBytesPerPartition"
+)
+ZORDER_TARGET_SOURCE_BYTES_PER_PARTITION_DEFAULT = 1024 * 1024 * 1024
+ZORDER_QUANTILE_ENABLED = "hyperspace.index.zorder.quantile.enabled"
+ZORDER_QUANTILE_ENABLED_DEFAULT = False
+ZORDER_QUANTILE_RELATIVE_ERROR = "hyperspace.index.zorder.quantile.relativeError"
+ZORDER_QUANTILE_RELATIVE_ERROR_DEFAULT = 0.01
+
+# Data-skipping (IndexConstants.scala:149-169)
+DATASKIPPING_TARGET_INDEX_DATA_FILE_SIZE = (
+    "hyperspace.index.dataskipping.targetIndexDataFileSize"
+)
+DATASKIPPING_TARGET_INDEX_DATA_FILE_SIZE_DEFAULT = 256 * 1024 * 1024
+DATASKIPPING_MAX_INDEX_DATA_FILE_COUNT = (
+    "hyperspace.index.dataskipping.maxIndexDataFileCount"
+)
+DATASKIPPING_MAX_INDEX_DATA_FILE_COUNT_DEFAULT = 10000
+DATASKIPPING_AUTO_PARTITION_SKETCH = (
+    "hyperspace.index.dataskipping.autoPartitionSketch"
+)
+DATASKIPPING_AUTO_PARTITION_SKETCH_DEFAULT = True
+
+EVENT_LOGGER_CLASS = "hyperspace.eventLoggerClass"
+
+# Number of device shards used for the build shuffle; default = all devices
+# in the session mesh.
+BUILD_NUM_SHARDS = "hyperspace.build.numShards"
+
+# ---------------------------------------------------------------------------
+# Reserved column / property names
+# ---------------------------------------------------------------------------
+
+# Lineage column (IndexConstants: DATA_FILE_NAME_ID = "_data_file_id")
+DATA_FILE_NAME_ID = "_data_file_id"
+
+# Index log directory + data-version prefix (IndexDataManager.scala:24-37)
+HYPERSPACE_LOG_DIR = "_hyperspace_log"
+INDEX_VERSION_DIR_PREFIX = "v__"
+LATEST_STABLE_LOG_NAME = "latestStable"
+
+# IndexLogEntry property keys
+LINEAGE_PROPERTY = "lineage"
+HAS_PARQUET_AS_SOURCE_FORMAT_PROPERTY = "hasParquetAsSourceFormat"
+DELTA_VERSION_HISTORY_PROPERTY = "deltaVersions"
+
+# Nested-column prefix (util/ResolverUtils.scala `__hs_nested.`)
+NESTED_FIELD_PREFIX = "__hs_nested."
+
+# Filenames written by the index data plane.
+INDEX_FILE_PREFIX = "part"

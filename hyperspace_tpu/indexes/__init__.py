@@ -1,0 +1,10 @@
+"""Index implementations ("derived datasets", L3).
+
+Reference: ``index/covering/``, ``index/zordercovering/``,
+``index/dataskipping/``; the polymorphic ``Index`` trait is
+``index/Index.scala:31-168``.
+"""
+
+from hyperspace_tpu.indexes.base import Index, IndexConfigTrait, UpdateMode
+
+__all__ = ["Index", "IndexConfigTrait", "UpdateMode"]

@@ -1,0 +1,97 @@
+"""Index trait + config trait.
+
+Reference: ``index/Index.scala:31-168`` (the contract every index kind
+implements; Jackson-polymorphic on a ``type`` property) and
+``index/IndexConfigTrait.scala:32-59`` (user config whose ``createIndex``
+returns the index object plus its data).
+"""
+
+from __future__ import annotations
+
+import abc
+import enum
+from typing import Dict, List, Optional, Tuple
+
+
+class UpdateMode(enum.Enum):
+    """How refreshed index data combines with the previous version
+    (Index.scala:162-168)."""
+
+    MERGE = "merge"          # new version dir adds to previous content
+    OVERWRITE = "overwrite"  # new version dir replaces previous content
+
+
+class Index(abc.ABC):
+    """A derived dataset. Subclasses must set ``kind`` and register in
+    :mod:`hyperspace_tpu.indexes.registry`."""
+
+    kind: str = "Index"
+    # Reference kindAbbr shown in plan strings, e.g. "CI" / "ZOCI" / "DS".
+    kind_abbr: str = "IX"
+
+    # -- serialization (polymorphic via "type") -----------------------------
+    @abc.abstractmethod
+    def to_dict(self) -> dict:
+        ...
+
+    @classmethod
+    @abc.abstractmethod
+    def from_dict(cls, d: dict) -> "Index":
+        ...
+
+    # -- schema surface -----------------------------------------------------
+    @property
+    @abc.abstractmethod
+    def indexed_columns(self) -> List[str]:
+        ...
+
+    @property
+    def included_columns(self) -> List[str]:
+        return []
+
+    def referenced_columns(self) -> List[str]:
+        return list(self.indexed_columns) + list(self.included_columns)
+
+    # -- data-plane operations (Index.scala write/optimize/refresh*) --------
+    @abc.abstractmethod
+    def write(self, ctx, index_data) -> None:
+        """Write ``index_data`` into ``ctx.index_data_path``."""
+
+    def optimize(self, ctx, files_to_optimize: List[str]) -> None:
+        raise NotImplementedError(f"{self.kind} does not support optimize")
+
+    def refresh_incremental(
+        self, ctx, appended_df, deleted_source_files, previous_content
+    ):
+        raise NotImplementedError(
+            f"{self.kind} does not support incremental refresh"
+        )
+
+    def refresh_full(self, ctx, df) -> "Tuple[Index, object]":
+        raise NotImplementedError(f"{self.kind} does not support full refresh")
+
+    @property
+    def can_handle_deleted_files(self) -> bool:
+        return False
+
+    def statistics(self, extended: bool = False) -> Dict[str, str]:
+        return {}
+
+
+class IndexConfigTrait(abc.ABC):
+    """User-supplied index definition (IndexConfigTrait.scala:32-59)."""
+
+    @property
+    @abc.abstractmethod
+    def index_name(self) -> str:
+        ...
+
+    @property
+    @abc.abstractmethod
+    def referenced_columns(self) -> List[str]:
+        ...
+
+    @abc.abstractmethod
+    def create_index(self, ctx, source_data, properties: Dict[str, str]):
+        """Return ``(Index, index_data)`` — the index object and the data to
+        write (IndexConfigTrait.createIndex)."""

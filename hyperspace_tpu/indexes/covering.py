@@ -1,0 +1,210 @@
+"""Covering index — the core index kind.
+
+Reference: ``index/covering/CoveringIndex.scala:33-193``,
+``CoveringIndexTrait.scala:32-135``, ``CoveringIndexConfig.scala:37-151``.
+
+A covering index is a vertical slice of the source (indexed + included
+columns), **hash-bucketed by the indexed columns and sorted within each
+bucket**, so that at query time it can substitute (a) the scan in a filter
+query with bucket pruning, and (b) the whole shuffle+sort in a sort-merge
+join (both sides co-bucketed ⇒ no exchange).
+
+TPU-native build pipeline (replaces ``indexData.repartition(numBuckets,
+cols) + saveWithBuckets``, CoveringIndex.scala:56-71):
+
+    host scan (arrow) → device columnar batches
+      → murmur3 hash of indexed cols (ops.hash, XLA)
+      → shard_map all-to-all over the mesh: row i goes to the device owning
+        bucket h(i) % num_buckets            (parallel.shuffle)
+      → per-device sort by (bucket, key)     (XLA sort on packed keys)
+      → host write: one parquet file per bucket under v__=N/
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from hyperspace_tpu.constants import DATA_FILE_NAME_ID, LINEAGE_PROPERTY
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.indexes.base import Index, IndexConfigTrait, UpdateMode
+from hyperspace_tpu.indexes.registry import register_index
+
+
+@register_index
+class CoveringIndex(Index):
+    kind = "CoveringIndex"
+    kind_abbr = "CI"
+
+    def __init__(
+        self,
+        indexed_columns: List[str],
+        included_columns: List[str],
+        schema_json: str,
+        num_buckets: int,
+        properties: Optional[Dict[str, str]] = None,
+    ):
+        self._indexed_columns = list(indexed_columns)
+        self._included_columns = list(included_columns)
+        self.schema_json = schema_json
+        self.num_buckets = int(num_buckets)
+        self.properties: Dict[str, str] = dict(properties or {})
+
+    # -- identity -----------------------------------------------------------
+    def __eq__(self, other):
+        return (
+            isinstance(other, CoveringIndex)
+            and self._indexed_columns == other._indexed_columns
+            and self._included_columns == other._included_columns
+            and self.num_buckets == other.num_buckets
+            and self.schema_json == other.schema_json
+        )
+
+    def __hash__(self):
+        return hash((tuple(self._indexed_columns), self.num_buckets))
+
+    # -- schema -------------------------------------------------------------
+    @property
+    def indexed_columns(self) -> List[str]:
+        return list(self._indexed_columns)
+
+    @property
+    def included_columns(self) -> List[str]:
+        return list(self._included_columns)
+
+    @property
+    def lineage_enabled(self) -> bool:
+        return str(self.properties.get(LINEAGE_PROPERTY, "false")).lower() == "true"
+
+    @property
+    def can_handle_deleted_files(self) -> bool:
+        # Deletes are compensated via the lineage column (CoveringIndexTrait)
+        return self.lineage_enabled
+
+    # -- serialization ------------------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "type": self.kind,
+            "kindAbbr": self.kind_abbr,
+            "indexedColumns": self._indexed_columns,
+            "includedColumns": self._included_columns,
+            "schemaJson": self.schema_json,
+            "numBuckets": self.num_buckets,
+            "properties": dict(self.properties),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CoveringIndex":
+        return cls(
+            d["indexedColumns"],
+            d.get("includedColumns", []),
+            d.get("schemaJson", ""),
+            d["numBuckets"],
+            d.get("properties", {}),
+        )
+
+    # -- data plane ---------------------------------------------------------
+    def write(self, ctx, index_data) -> None:
+        """Bucketed + sorted write (CoveringIndex.write:56-71)."""
+        from hyperspace_tpu.indexes import covering_build
+
+        covering_build.write_bucketed(
+            ctx, index_data, self._indexed_columns, self.num_buckets
+        )
+
+    def optimize(self, ctx, files_to_optimize: List[str]) -> None:
+        """Read the listed index files and rewrite them bucketed
+        (CoveringIndexTrait.optimize:130-134)."""
+        from hyperspace_tpu.indexes import covering_build
+
+        covering_build.rewrite_files(
+            ctx, files_to_optimize, self._indexed_columns, self.num_buckets
+        )
+
+    def refresh_incremental(
+        self, ctx, appended_df, deleted_source_file_ids, previous_content
+    ) -> Tuple["CoveringIndex", UpdateMode]:
+        """Incremental refresh (CoveringIndexTrait.refreshIncremental:57-106):
+
+        * appended source files → index only those rows into the new version
+          dir (same bucketing ⇒ merge keeps co-bucketing);
+        * deleted source files → rewrite previous index data minus rows whose
+          lineage id is in ``deleted_source_file_ids``.
+        Returns (index, UpdateMode.MERGE | OVERWRITE).
+        """
+        from hyperspace_tpu.indexes import covering_build
+
+        return covering_build.refresh_incremental(
+            ctx,
+            self,
+            appended_df,
+            deleted_source_file_ids,
+            previous_content,
+        )
+
+    def statistics(self, extended: bool = False) -> Dict[str, str]:
+        return {
+            "indexedColumns": ",".join(self._indexed_columns),
+            "includedColumns": ",".join(self._included_columns),
+            "numBuckets": str(self.num_buckets),
+            "schema": self.schema_json if extended else "",
+        }
+
+
+class CoveringIndexConfig(IndexConfigTrait):
+    """name + indexedColumns + includedColumns
+    (CoveringIndexConfig.scala:37-151)."""
+
+    def __init__(
+        self,
+        index_name: str,
+        indexed_columns: List[str],
+        included_columns: Optional[List[str]] = None,
+    ):
+        if not index_name:
+            raise HyperspaceException("Index name cannot be empty")
+        if not indexed_columns:
+            raise HyperspaceException("indexed_columns cannot be empty")
+        lowered = [c.lower() for c in indexed_columns]
+        if len(set(lowered)) != len(lowered):
+            raise HyperspaceException("Duplicate indexed column names")
+        inc = list(included_columns or [])
+        if set(c.lower() for c in inc) & set(lowered):
+            raise HyperspaceException(
+                "Duplicate column names in indexed/included columns"
+            )
+        self._name = index_name
+        self._indexed = list(indexed_columns)
+        self._included = inc
+
+    def __repr__(self):
+        return (
+            f"CoveringIndexConfig(indexName={self._name!r}, "
+            f"indexedColumns={self._indexed}, includedColumns={self._included})"
+        )
+
+    @property
+    def index_name(self) -> str:
+        return self._name
+
+    @property
+    def indexed_columns(self) -> List[str]:
+        return list(self._indexed)
+
+    @property
+    def included_columns(self) -> List[str]:
+        return list(self._included)
+
+    @property
+    def referenced_columns(self) -> List[str]:
+        return self._indexed + self._included
+
+    def create_index(self, ctx, source_data, properties: Dict[str, str]):
+        """(CoveringIndex, index_data) — projection + optional lineage column
+        (CoveringIndexConfig.createIndex:43-61 →
+        CoveringIndex.createIndexData:140-192)."""
+        from hyperspace_tpu.indexes import covering_build
+
+        return covering_build.create_covering_index(
+            ctx, source_data, self, properties
+        )

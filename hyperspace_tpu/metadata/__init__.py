@@ -1,0 +1,44 @@
+"""Metadata plane (L1): operation log, versioned index data, path layout.
+
+Reference: ``src/main/scala/com/microsoft/hyperspace/index/`` —
+``IndexLogEntry.scala``, ``IndexLogManager.scala``, ``IndexDataManager.scala``,
+``PathResolver.scala``. Entirely host-side; no Spark/JVM dependence in the
+reference either, which is why this layer ports semantically 1:1 while the
+data plane below it is re-designed for TPU.
+"""
+
+from hyperspace_tpu.metadata.entry import (
+    Content,
+    Directory,
+    FileIdTracker,
+    FileInfo,
+    IndexLogEntry,
+    LogEntry,
+    LogicalPlanFingerprint,
+    Relation,
+    Signature,
+    Source,
+    SourcePlan,
+    Update,
+)
+from hyperspace_tpu.metadata.log_manager import IndexLogManager
+from hyperspace_tpu.metadata.data_manager import IndexDataManager
+from hyperspace_tpu.metadata.path_resolver import PathResolver
+
+__all__ = [
+    "Content",
+    "Directory",
+    "FileIdTracker",
+    "FileInfo",
+    "IndexLogEntry",
+    "LogEntry",
+    "LogicalPlanFingerprint",
+    "Relation",
+    "Signature",
+    "Source",
+    "SourcePlan",
+    "Update",
+    "IndexLogManager",
+    "IndexDataManager",
+    "PathResolver",
+]

@@ -1,0 +1,45 @@
+"""Resolve index names to index root directories.
+
+Reference: ``index/PathResolver.scala:30-70`` — root is the
+``hyperspace.system.path`` conf (default ``<warehouse>/indexes``); lookup
+is case-insensitive against existing directories.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+from hyperspace_tpu import constants as C
+
+
+DEFAULT_SYSTEM_PATH = os.path.join(os.path.expanduser("~"), "hyperspace", "indexes")
+
+
+class PathResolver:
+    def __init__(self, conf):
+        self._conf = conf
+
+    @property
+    def system_path(self) -> str:
+        return self._conf.get_str(C.INDEX_SYSTEM_PATH, DEFAULT_SYSTEM_PATH)
+
+    def get_index_path(self, name: str) -> str:
+        """Existing dir matching case-insensitively, else ``<root>/<name>``
+        (getIndexPath:39-58)."""
+        root = self.system_path
+        if os.path.isdir(root):
+            for existing in os.listdir(root):
+                if existing.lower() == name.lower():
+                    return os.path.join(root, existing)
+        return os.path.join(root, name)
+
+    def all_index_paths(self) -> List[str]:
+        root = self.system_path
+        if not os.path.isdir(root):
+            return []
+        return [
+            os.path.join(root, n)
+            for n in sorted(os.listdir(root))
+            if os.path.isdir(os.path.join(root, n))
+        ]

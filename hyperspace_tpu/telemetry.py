@@ -1,0 +1,129 @@
+"""Typed telemetry events + pluggable event logger.
+
+Reference: ``telemetry/HyperspaceEvent.scala:28-166`` (event case classes),
+``telemetry/HyperspaceEventLogging.scala:30-68`` (pluggable logger via
+``spark.hyperspace.eventLoggerClass``, default no-op).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import time
+from typing import List, Optional
+
+from hyperspace_tpu import constants as C
+
+
+@dataclasses.dataclass
+class AppInfo:
+    """Reference: telemetry/HyperspaceEvent.scala AppInfo(sparkUser, appId, appName)."""
+
+    user: str = ""
+    app_id: str = ""
+    app_name: str = "hyperspace_tpu"
+
+
+@dataclasses.dataclass
+class HyperspaceEvent:
+    app_info: AppInfo = dataclasses.field(default_factory=AppInfo)
+    message: str = ""
+    timestamp_ms: int = dataclasses.field(
+        default_factory=lambda: int(time.time() * 1000)
+    )
+
+
+@dataclasses.dataclass
+class HyperspaceIndexCRUDEvent(HyperspaceEvent):
+    index_name: str = ""
+
+
+@dataclasses.dataclass
+class CreateActionEvent(HyperspaceIndexCRUDEvent):
+    pass
+
+
+@dataclasses.dataclass
+class DeleteActionEvent(HyperspaceIndexCRUDEvent):
+    pass
+
+
+@dataclasses.dataclass
+class RestoreActionEvent(HyperspaceIndexCRUDEvent):
+    pass
+
+
+@dataclasses.dataclass
+class VacuumActionEvent(HyperspaceIndexCRUDEvent):
+    pass
+
+
+@dataclasses.dataclass
+class VacuumOutdatedActionEvent(HyperspaceIndexCRUDEvent):
+    pass
+
+
+@dataclasses.dataclass
+class RefreshActionEvent(HyperspaceIndexCRUDEvent):
+    mode: str = C.REFRESH_MODE_FULL
+
+
+@dataclasses.dataclass
+class RefreshIncrementalActionEvent(HyperspaceIndexCRUDEvent):
+    pass
+
+
+@dataclasses.dataclass
+class RefreshQuickActionEvent(HyperspaceIndexCRUDEvent):
+    pass
+
+
+@dataclasses.dataclass
+class OptimizeActionEvent(HyperspaceIndexCRUDEvent):
+    mode: str = C.OPTIMIZE_MODE_QUICK
+
+
+@dataclasses.dataclass
+class CancelActionEvent(HyperspaceIndexCRUDEvent):
+    pass
+
+
+@dataclasses.dataclass
+class HyperspaceIndexUsageEvent(HyperspaceEvent):
+    """Emitted when the planner picks index(es) for a query.
+
+    Reference: covering/JoinIndexRule.scala:678-684.
+    """
+
+    index_names: List[str] = dataclasses.field(default_factory=list)
+    plan: str = ""
+
+
+class EventLogger:
+    """Pluggable sink. Default = no-op (telemetry/HyperspaceEventLogging.scala:66)."""
+
+    def log_event(self, event: HyperspaceEvent) -> None:  # pragma: no cover
+        pass
+
+
+class EventLogging:
+    """Dispatches events to the logger class named in config."""
+
+    def __init__(self, conf):
+        self._conf = conf
+        self._logger: Optional[EventLogger] = None
+        self._logger_cls_name: Optional[str] = None
+
+    def _resolve(self) -> EventLogger:
+        name = self._conf.get_str(C.EVENT_LOGGER_CLASS, "")
+        if self._logger is None or name != self._logger_cls_name:
+            if name:
+                mod, _, cls = name.rpartition(".")
+                self._logger = getattr(importlib.import_module(mod), cls)()
+            else:
+                self._logger = EventLogger()
+            self._logger_cls_name = name
+        return self._logger
+
+    def log_event(self, event: HyperspaceEvent) -> None:
+        self._resolve().log_event(event)

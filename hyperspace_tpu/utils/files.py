@@ -1,0 +1,113 @@
+"""Filesystem helpers used by the metadata plane.
+
+Reference: ``util/FileUtils.scala`` (create/delete/read through the Hadoop
+``FileSystem`` API). This build targets a POSIX filesystem (and, by
+extension, FUSE-mounted object stores); the one primitive whose semantics
+matter is *atomic create-if-absent*, used by the operation log's optimistic
+concurrency (``index/IndexLogManager.scala:178-194``).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+from typing import Iterator, List, Tuple
+
+
+def write_text(path: str, text: str) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(text)
+
+
+def read_text(path: str) -> str:
+    with open(path, "r", encoding="utf-8") as f:
+        return f.read()
+
+
+def atomic_write_if_absent(path: str, text: str) -> bool:
+    """Create ``path`` with ``text`` iff it does not exist; atomic.
+
+    Mirrors the reference's temp-file + rename-without-overwrite protocol
+    (``IndexLogManagerImpl.writeLog:178-194``): write to a temp file in the
+    same directory, then ``os.link`` it to the final name. ``link`` fails
+    with EEXIST if another writer won the race — the optimistic-concurrency
+    conflict signal. Returns True on success, False on conflict.
+
+    On object stores this maps to a generation-match precondition
+    (if-generation-match=0 on GCS); the boolean contract is identical.
+    FUSE mounts that don't support hard links fall back to exclusive
+    create (O_EXCL), which those mounts do honor.
+    """
+    d = os.path.dirname(path)
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp_log_")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            f.write(text)
+        try:
+            os.link(tmp, path)
+            return True
+        except FileExistsError:
+            return False
+        except OSError:
+            # Hard links unsupported (FUSE object-store mounts): O_EXCL path.
+            try:
+                with open(path, "x", encoding="utf-8") as f:
+                    f.write(text)
+                return True
+            except FileExistsError:
+                return False
+    finally:
+        os.unlink(tmp)
+
+
+def atomic_overwrite(path: str, text: str) -> None:
+    """Atomically replace ``path`` with ``text`` (latestStable pointer)."""
+    d = os.path.dirname(path)
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp_log_")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            f.write(text)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except FileNotFoundError:
+            pass
+        raise
+
+
+def delete(path: str) -> None:
+    """Recursive delete, ignore-missing (FileUtils.delete)."""
+    if os.path.isdir(path) and not os.path.islink(path):
+        shutil.rmtree(path, ignore_errors=True)
+    else:
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
+
+
+def list_leaf_files(root: str) -> List[Tuple[str, int, int]]:
+    """Recursive listing of (path, size, mtime_ms) for all regular files.
+
+    Equivalent to the recursive ``listStatus`` in
+    ``Content.fromDirectory`` (IndexLogEntry.scala:86-96).
+    """
+    out: List[Tuple[str, int, int]] = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in sorted(filenames):
+            p = os.path.join(dirpath, name)
+            try:
+                st = os.stat(p)
+            except FileNotFoundError:
+                continue
+            out.append((p, st.st_size, int(st.st_mtime * 1000)))
+    return out
+
+
+def dir_size(root: str) -> int:
+    return sum(size for _p, size, _m in list_leaf_files(root))

@@ -1,0 +1,17 @@
+"""Host-side hashing utilities.
+
+Reference: ``util/HashingUtils.scala`` (md5 for plan/file fingerprints).
+Device-side hashing (bucket assignment) lives in
+:mod:`hyperspace_tpu.ops.hash` — it must be an XLA-compilable function, not
+a host hash.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any
+
+
+def md5_hex(value: Any) -> str:
+    """md5 of ``str(value)`` as hex — mirrors HashingUtils.md5Hex."""
+    return hashlib.md5(str(value).encode("utf-8")).hexdigest()

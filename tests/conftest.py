@@ -1,0 +1,68 @@
+"""Test fixtures.
+
+Distribution is simulated on a virtual 8-device CPU mesh — the TPU
+equivalent of the reference's ``local[4]`` Spark test sessions
+(``SparkInvolvedSuite.scala:31-47``): set XLA_FLAGS before JAX import so
+``jax.devices()`` reports 8 host devices.
+"""
+
+import os
+
+# Must happen before any jax import anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_index_root(tmp_path):
+    """Per-test index system path (HyperspaceSuite's per-suite systemPath)."""
+    p = tmp_path / "indexes"
+    p.mkdir()
+    return str(p)
+
+
+@pytest.fixture
+def sample_parquet(tmp_path):
+    """Small parquet dataset (reference SampleData.scala analogue)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(0)
+    d = tmp_path / "sample"
+    d.mkdir()
+    for i in range(3):
+        n = 100
+        t = pa.table(
+            {
+                "date": pa.array(
+                    [f"2017-09-{(j % 28) + 1:02d}" for j in range(n)]
+                ),
+                "rguid": pa.array([f"guid-{i}-{j}" for j in range(n)]),
+                "clicks": pa.array(rng.integers(0, 1000, n), type=pa.int64()),
+                "query": pa.array(
+                    [["ibraco", "facebook", "donde", "banana"][j % 4] for j in range(n)]
+                ),
+                "imprs": pa.array(rng.integers(0, 100, n), type=pa.int64()),
+            }
+        )
+        pq.write_table(t, d / f"part-{i}.parquet")
+    return str(d)
+
+
+@pytest.fixture
+def session(tmp_index_root):
+    from hyperspace_tpu.session import HyperspaceSession
+    from hyperspace_tpu import constants as C
+
+    s = HyperspaceSession()
+    s.conf.set(C.INDEX_SYSTEM_PATH, tmp_index_root)
+    # Small bucket count for tests (reference tests use 5 shuffle partitions)
+    s.conf.set(C.INDEX_NUM_BUCKETS, 8)
+    return s

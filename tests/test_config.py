@@ -1,0 +1,40 @@
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.config import CacheWithTransform, Config
+
+
+def test_defaults():
+    conf = Config()
+    assert conf.apply_enabled is True
+    assert conf.num_buckets == 200
+    assert conf.lineage_enabled is False
+    assert conf.hybrid_scan_enabled is False
+    assert conf.hybrid_scan_max_appended_ratio == 0.3
+    assert conf.hybrid_scan_max_deleted_ratio == 0.2
+    assert conf.optimize_file_size_threshold == 256 * 1024 * 1024
+
+
+def test_set_get_typed():
+    conf = Config()
+    conf.set(C.INDEX_NUM_BUCKETS, "16")
+    assert conf.num_buckets == 16
+    conf.set(C.INDEX_LINEAGE_ENABLED, "true")
+    assert conf.lineage_enabled is True
+    conf.set(C.INDEX_LINEAGE_ENABLED, False)
+    assert conf.lineage_enabled is False
+
+
+def test_cache_with_transform_invalidates_on_change():
+    conf = Config()
+    calls = []
+
+    def transform(c):
+        calls.append(1)
+        return c.num_buckets * 2
+
+    cache = CacheWithTransform(conf, transform)
+    assert cache.load() == 400
+    assert cache.load() == 400
+    assert len(calls) == 1
+    conf.set(C.INDEX_NUM_BUCKETS, 10)
+    assert cache.load() == 20
+    assert len(calls) == 2

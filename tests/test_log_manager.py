@@ -1,0 +1,94 @@
+"""Operation-log manager tests (reference: index/IndexLogManagerImplTest.scala)."""
+
+import os
+
+from hyperspace_tpu.constants import States
+from hyperspace_tpu.metadata.data_manager import IndexDataManager, version_from_path
+from hyperspace_tpu.metadata.log_manager import IndexLogManager
+from hyperspace_tpu.metadata.path_resolver import PathResolver
+from hyperspace_tpu.config import Config
+from hyperspace_tpu import constants as C
+
+from test_metadata_entry import make_entry
+
+
+def test_write_and_read_log(tmp_path):
+    mgr = IndexLogManager(str(tmp_path / "idx"))
+    entry = make_entry(state=States.CREATING)
+    assert mgr.write_log(0, entry) is True
+    got = mgr.get_log(0)
+    assert got is not None and got.state == States.CREATING
+    assert mgr.get_latest_id() == 0
+
+
+def test_write_log_occ_conflict(tmp_path):
+    mgr = IndexLogManager(str(tmp_path / "idx"))
+    assert mgr.write_log(0, make_entry(state=States.CREATING)) is True
+    # Second writer loses the race on the same id.
+    assert mgr.write_log(0, make_entry(state=States.CREATING)) is False
+
+
+def test_latest_stable_pointer_and_fallback(tmp_path):
+    mgr = IndexLogManager(str(tmp_path / "idx"))
+    assert mgr.get_latest_stable_log() is None
+    assert mgr.write_log(0, make_entry(state=States.CREATING))
+    assert mgr.get_latest_stable_log() is None  # no stable state yet
+    assert mgr.write_log(1, make_entry(state=States.ACTIVE))
+    # Without the pointer file, backwards scan finds id 1.
+    found = mgr.get_latest_stable_log()
+    assert found is not None and found.state == States.ACTIVE and found.id == 1
+    # Pointer file path.
+    assert mgr.create_latest_stable_log(1) is True
+    found2 = mgr.get_latest_stable_log()
+    assert found2 is not None and found2.id == 1
+    # Pointer to a transient state is rejected.
+    assert mgr.write_log(2, make_entry(state=States.REFRESHING))
+    assert mgr.create_latest_stable_log(2) is False
+
+
+def test_get_index_versions(tmp_path):
+    mgr = IndexLogManager(str(tmp_path / "idx"))
+    mgr.write_log(0, make_entry(state=States.CREATING))
+    mgr.write_log(1, make_entry(state=States.ACTIVE))
+    mgr.write_log(2, make_entry(state=States.REFRESHING))
+    mgr.write_log(3, make_entry(state=States.ACTIVE))
+    assert mgr.get_index_versions([States.ACTIVE]) == [3, 1]
+    assert mgr.get_index_versions([States.CREATING, States.REFRESHING]) == [2, 0]
+
+
+def test_data_manager_versions(tmp_path):
+    root = str(tmp_path / "idx")
+    dm = IndexDataManager(root)
+    assert dm.get_latest_version_id() is None
+    os.makedirs(dm.get_path(0))
+    os.makedirs(dm.get_path(3))
+    assert dm.get_all_versions() == [0, 3]
+    assert dm.get_latest_version_id() == 3
+    assert dm.get_path(3).endswith("v__=3")
+    dm.delete(3)
+    assert dm.get_latest_version_id() == 0
+
+
+def test_version_from_path():
+    assert version_from_path("/idx/v__=7/part-0.parquet") == 7
+    assert version_from_path("/idx/v__=12") == 12
+    assert version_from_path("/idx/nope/part-0.parquet") is None
+
+
+def test_path_resolver_case_insensitive(tmp_path):
+    conf = Config({C.INDEX_SYSTEM_PATH: str(tmp_path)})
+    r = PathResolver(conf)
+    os.makedirs(str(tmp_path / "MyIndex"))
+    assert r.get_index_path("myindex") == str(tmp_path / "MyIndex")
+    assert r.get_index_path("other") == str(tmp_path / "other")
+    assert r.all_index_paths() == [str(tmp_path / "MyIndex")]
+
+
+def test_write_log_does_not_stamp_id_on_conflict(tmp_path):
+    mgr = IndexLogManager(str(tmp_path / "idx"))
+    winner = make_entry(state=States.CREATING)
+    assert mgr.write_log(0, winner) and winner.id == 0
+    loser = make_entry(state=States.CREATING)
+    loser.id = 99
+    assert mgr.write_log(0, loser) is False
+    assert loser.id == 99  # untouched on conflict

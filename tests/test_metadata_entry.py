@@ -1,0 +1,150 @@
+"""Log-entry model tests (reference: index/IndexLogEntryTest.scala)."""
+
+import pytest
+
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.indexes.covering import CoveringIndex
+from hyperspace_tpu.metadata.entry import (
+    Content,
+    Directory,
+    FileIdTracker,
+    FileInfo,
+    IndexLogEntry,
+    LogicalPlanFingerprint,
+    Relation,
+    Signature,
+    Source,
+    SourcePlan,
+)
+
+
+def make_entry(state="ACTIVE", num_buckets=8):
+    src_content = Content.from_leaf_files(
+        [("/data/t/part-0.parquet", 100, 1000), ("/data/t/part-1.parquet", 200, 2000)]
+    )
+    idx_content = Content.from_leaf_files(
+        [("/idx/v__=0/part-00000.parquet", 10, 1)]
+    )
+    index = CoveringIndex(["k"], ["v"], "{}", num_buckets)
+    rel = Relation(["/data/t"], src_content, "{}", "parquet")
+    return IndexLogEntry(
+        name="myIndex",
+        derived_dataset=index,
+        content=idx_content,
+        source=Source(SourcePlan([rel])),
+        fingerprint=LogicalPlanFingerprint([Signature("file", "abc123")]),
+        state=state,
+        id=2,
+    )
+
+
+def test_fileinfo_equality_ignores_id():
+    a = FileInfo("f", 1, 2, id=5)
+    b = FileInfo("f", 1, 2, id=9)
+    assert a == b and hash(a) == hash(b)
+    assert a != FileInfo("f", 1, 3, id=5)
+
+
+def test_directory_from_leaf_files_builds_tree():
+    c = Content.from_leaf_files(
+        [
+            ("/a/b/f1", 1, 10),
+            ("/a/b/f2", 2, 20),
+            ("/a/c/f3", 3, 30),
+        ]
+    )
+    assert sorted(c.files) == ["/a/b/f1", "/a/b/f2", "/a/c/f3"]
+    assert c.size_in_bytes == 6
+    root = c.root
+    assert root.name == "/"
+    assert [d.name for d in root.subdirs] == ["a"]
+    assert sorted(d.name for d in root.subdirs[0].subdirs) == ["b", "c"]
+
+
+def test_directory_merge_unions_files():
+    c1 = Content.from_leaf_files([("/a/b/f1", 1, 10), ("/a/b/f2", 2, 20)])
+    c2 = Content.from_leaf_files([("/a/b/f2", 2, 20), ("/a/d/f4", 4, 40)])
+    merged = c1.merge(c2)
+    assert sorted(merged.files) == ["/a/b/f1", "/a/b/f2", "/a/d/f4"]
+    assert merged.size_in_bytes == 7
+
+
+def test_directory_merge_name_mismatch_raises():
+    with pytest.raises(HyperspaceException):
+        Directory("a").merge(Directory("b"))
+
+
+def test_file_id_tracker_stable_ids():
+    t = FileIdTracker()
+    a = t.add_file("/x/f1", 1, 10)
+    b = t.add_file("/x/f2", 2, 20)
+    assert (a, b) == (0, 1)
+    assert t.add_file("/x/f1", 1, 10) == 0      # stable
+    assert t.add_file("/x/f1", 1, 99) == 2      # modified file = new id
+    assert t.max_id == 2
+    mapping = dict(t.id_to_file_mapping())
+    assert mapping[0] == "/x/f1" and mapping[1] == "/x/f2"
+
+
+def test_file_id_tracker_seed_conflict():
+    t = FileIdTracker()
+    t.add_file_info("/x/f1", FileInfo("f1", 1, 10, id=7))
+    assert t.get_file_id("/x/f1", 1, 10) == 7
+    assert t.max_id == 7
+    with pytest.raises(HyperspaceException):
+        t.add_file_info("/x/f1", FileInfo("f1", 1, 10, id=8))
+
+
+def test_log_entry_json_roundtrip():
+    entry = make_entry()
+    d = entry.to_dict()
+    back = IndexLogEntry.from_dict(d)
+    assert back == entry
+    assert back.derived_dataset.indexed_columns == ["k"]
+    assert back.derived_dataset.num_buckets == 8
+    assert back.relation.root_paths == ["/data/t"]
+    assert back.source_files_size_in_bytes == 300
+
+
+def test_copy_with_update_records_delta():
+    entry = make_entry()
+    appended = Content.from_leaf_files([("/data/t/part-2.parquet", 50, 3000)])
+    deleted = Content.from_leaf_files([("/data/t/part-0.parquet", 100, 1000)])
+    fp = LogicalPlanFingerprint([Signature("file", "newsig")])
+    updated = entry.copy_with_update(appended, deleted, fp)
+    # original untouched
+    assert entry.relation.update is None
+    files = updated.source_file_info_set()
+    assert "/data/t/part-2.parquet" in files
+    assert "/data/t/part-0.parquet" not in files
+    assert "/data/t/part-1.parquet" in files
+    assert updated.fingerprint.signatures[0].value == "newsig"
+    # roundtrip preserves update
+    back = IndexLogEntry.from_dict(updated.to_dict())
+    assert back.source_file_info_set().keys() == files.keys()
+
+
+def test_tags_are_per_plan_and_not_serialized():
+    entry = make_entry()
+    entry.set_tag("plan1", "HYBRIDSCAN_REQUIRED", True)
+    assert entry.get_tag("plan1", "HYBRIDSCAN_REQUIRED") is True
+    assert entry.get_tag("plan2", "HYBRIDSCAN_REQUIRED") is None
+    back = IndexLogEntry.from_dict(entry.to_dict())
+    assert back.get_tag("plan1", "HYBRIDSCAN_REQUIRED") is None
+
+
+def test_index_data_dir_id():
+    entry = make_entry()
+    assert entry.index_data_dir_id() == 0
+
+
+def test_scheme_qualified_paths_roundtrip():
+    c = Content.from_leaf_files(
+        [("gs://bucket/data/f1.parquet", 5, 1), ("gs://bucket/data/sub/f2.parquet", 6, 2)]
+    )
+    assert sorted(c.files) == [
+        "gs://bucket/data/f1.parquet",
+        "gs://bucket/data/sub/f2.parquet",
+    ]
+    back = Content.from_dict(c.to_dict())
+    assert sorted(back.files) == sorted(c.files)

@@ -1,0 +1,64 @@
+import os
+import threading
+
+from hyperspace_tpu.utils import files, paths
+from hyperspace_tpu.utils.resolver import ResolvedColumn, resolve
+
+
+def test_atomic_write_if_absent(tmp_path):
+    p = str(tmp_path / "log" / "1")
+    assert files.atomic_write_if_absent(p, "first") is True
+    assert files.atomic_write_if_absent(p, "second") is False
+    assert files.read_text(p) == "first"
+
+
+def test_atomic_write_concurrent(tmp_path):
+    """Exactly one of N concurrent writers must win (OCC contract)."""
+    p = str(tmp_path / "log" / "7")
+    results = []
+
+    def writer(i):
+        results.append(files.atomic_write_if_absent(p, f"writer-{i}"))
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sum(results) == 1
+    assert files.read_text(p).startswith("writer-")
+
+
+def test_list_leaf_files(tmp_path):
+    (tmp_path / "a" / "b").mkdir(parents=True)
+    (tmp_path / "a" / "x.txt").write_text("xx")
+    (tmp_path / "a" / "b" / "y.txt").write_text("yyy")
+    listed = files.list_leaf_files(str(tmp_path))
+    names = sorted(os.path.basename(p) for p, _, _ in listed)
+    assert names == ["x.txt", "y.txt"]
+    sizes = {os.path.basename(p): s for p, s, _ in listed}
+    assert sizes == {"x.txt": 2, "y.txt": 3}
+
+
+def test_data_path_filter():
+    assert paths.is_data_path("/x/part-0.parquet")
+    assert not paths.is_data_path("/x/_hyperspace_log")
+    assert not paths.is_data_path("/x/.hidden")
+    assert not paths.is_data_path("/x/_SUCCESS")
+
+
+def test_resolve_case_insensitive():
+    assert resolve(["Query", "CLICKS"], ["query", "clicks", "imprs"]) == [
+        ResolvedColumn("query"),
+        ResolvedColumn("clicks"),
+    ]
+    assert resolve(["nope"], ["query"]) is None
+    assert resolve(["Query"], ["query"], case_sensitive=True) is None
+
+
+def test_resolve_nested():
+    r = resolve(["a.b"], ["x"], nested_available=["a.b"])
+    assert r == [ResolvedColumn("a.b", True)]
+    assert r[0].normalized_name == "__hs_nested.a.b"
+    back = ResolvedColumn.from_normalized("__hs_nested.a.b")
+    assert back.is_nested and back.name == "a.b"
