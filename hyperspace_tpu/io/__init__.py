@@ -1,0 +1,11 @@
+"""Host I/O layer: Arrow/Parquet read-write and the host↔device columnar
+batch representation.
+
+The reference delegates all I/O to Spark's ``FileFormat``/``FileSourceScanExec``
+machinery; here the host side is Arrow (no JVM) and the device side is SoA
+numpy/JAX arrays (see :mod:`hyperspace_tpu.io.columnar`).
+"""
+
+from hyperspace_tpu.io.columnar import Column, ColumnarBatch
+
+__all__ = ["Column", "ColumnarBatch"]

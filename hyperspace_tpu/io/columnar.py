@@ -1,0 +1,304 @@
+"""Device-friendly columnar batches (SoA).
+
+The TPU data plane cannot operate on Arrow's variable-width layouts
+directly: strings are dictionary-encoded at ingest (codes live on device,
+dictionary bytes stay host-side), fixed-width columns become numpy/JAX
+arrays, and nulls become validity masks. This replaces the role Spark's
+``ColumnarBatch``/``UnsafeRow`` plays under the reference's scan and shuffle
+(e.g. ``index/covering/CoveringIndex.scala:56-71`` writes via Spark's row
+pipeline; our equivalent pipeline consumes these batches).
+
+Key-representation ("key rep") contract
+---------------------------------------
+Bucketing and sorting on device need a stable ``int64`` per value that is
+*identical across files, sessions and refreshes*:
+
+* numeric / bool / date / timestamp → the value's 64-bit pattern
+  (floats via bit view so NaN groups deterministically);
+* strings → murmur3-128-derived 64-bit hash of the utf-8 bytes, computed
+  host-side **per dictionary entry** (O(unique), not O(rows)) then gathered
+  through the codes on device;
+* null → a fixed sentinel.
+
+Equality of key reps implies equality of values except for string hash
+collisions, which consumers (merge join) must verify against the actual
+bytes; ordering of reps is an arbitrary-but-consistent total order, which
+is all hash bucketing and sort-merge joins require.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.utils.hashing import murmur3_64_bytes
+
+# Key rep reserved for nulls. Chosen to be an unlikely hash/bit pattern.
+NULL_KEY_REP = np.int64(-0x7FFF_FFFF_FFFF_FF13)
+
+def _is_string(t: pa.DataType) -> bool:
+    if pa.types.is_dictionary(t):
+        t = t.value_type
+    return pa.types.is_string(t) or pa.types.is_large_string(t)
+
+
+@dataclasses.dataclass
+class Column:
+    """One column of a :class:`ColumnarBatch`.
+
+    kind:
+      * ``numeric`` — ``values`` holds the numpy array (ints/floats/bool/
+        date/timestamp as their natural numpy dtype);
+      * ``string`` — ``codes`` holds int32 dictionary codes (-1 = null)
+        and ``dictionary`` the host-side list of Python strings.
+    ``validity`` is None (no nulls) or a bool mask (True = valid).
+    ``arrow_type`` preserves the logical type for lossless round-trip.
+    """
+
+    kind: str
+    arrow_type: pa.DataType
+    values: Optional[np.ndarray] = None
+    codes: Optional[np.ndarray] = None
+    dictionary: Optional[List[str]] = None
+    validity: Optional[np.ndarray] = None
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def from_arrow(arr: pa.ChunkedArray | pa.Array) -> "Column":
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        t = arr.type
+        if _is_string(t):
+            atype = t.value_type if pa.types.is_dictionary(t) else t
+            if not pa.types.is_dictionary(t):
+                arr = arr.dictionary_encode()
+            codes = arr.indices.to_numpy(zero_copy_only=False)
+            codes = np.where(np.asarray(arr.indices.is_valid()), codes, -1).astype(
+                np.int32
+            )
+            dictionary = arr.dictionary.to_pylist()
+            return Column("string", atype, codes=codes, dictionary=dictionary)
+        if pa.types.is_dictionary(t):
+            # dictionary-of-non-string (e.g. parquet read_dictionary on an
+            # int column): decode and treat as a plain fixed-width column.
+            arr = arr.cast(t.value_type)
+            t = arr.type
+        validity = None
+        if arr.null_count:
+            validity = np.asarray(arr.is_valid())
+            # Fill nulls with a typed zero so to_numpy keeps the natural
+            # dtype (nullable ints would otherwise decode as float64 and
+            # break the cross-file key-rep stability contract).
+            fill = pa.scalar(False if pa.types.is_boolean(t) else 0, type=t)
+            arr = arr.fill_null(fill)
+        vals = arr.to_numpy(zero_copy_only=False)
+        if vals.dtype == object:
+            vals = vals.astype(_numpy_dtype_for(t))
+        if vals.dtype.kind == "M":  # datetime64 → int64 for device friendliness
+            vals = vals.view(np.int64)
+        return Column("numeric", t, values=vals, validity=validity)
+
+    # -- basic properties ---------------------------------------------------
+    def __len__(self) -> int:
+        n = self.values if self.kind == "numeric" else self.codes
+        return len(n)
+
+    @property
+    def null_mask(self) -> Optional[np.ndarray]:
+        """True where the value is null, or None when there are no nulls."""
+        if self.kind == "string":
+            if (self.codes < 0).any():
+                return self.codes < 0
+            return None
+        if self.validity is not None:
+            return ~self.validity
+        return None
+
+    # -- conversion ---------------------------------------------------------
+    def to_arrow(self) -> pa.Array:
+        if self.kind == "string":
+            codes = self.codes
+            mask = codes < 0
+            safe = np.where(mask, 0, codes)
+            arr = pa.DictionaryArray.from_arrays(
+                pa.array(safe, type=pa.int32(), mask=mask),
+                pa.array(self.dictionary, type=self.arrow_type),
+            )
+            return arr.cast(self.arrow_type)
+        vals = self.values
+        mask = None if self.validity is None else ~self.validity
+        t = self.arrow_type
+        if pa.types.is_timestamp(t) or pa.types.is_date(t) or pa.types.is_time(t):
+            # stored as int64 epoch units; 32-bit temporal types cast via int32
+            width = 32 if t in (pa.date32(), pa.time32("s"), pa.time32("ms")) else 64
+            itype = pa.int32() if width == 32 else pa.int64()
+            ivals = vals.astype(np.int32) if width == 32 else vals
+            return pa.array(ivals, type=itype, mask=mask).cast(t)
+        return pa.array(vals, type=t, mask=mask)
+
+    def key_rep(self) -> np.ndarray:
+        """Stable int64 representation for bucketing/sorting (see module
+        docstring)."""
+        if self.kind == "string":
+            dict_reps = np.array(
+                [murmur3_64_bytes(s.encode("utf-8")) for s in self.dictionary],
+                dtype=np.int64,
+            )
+            if len(dict_reps) == 0:
+                dict_reps = np.zeros(1, dtype=np.int64)
+            reps = dict_reps[np.where(self.codes < 0, 0, self.codes)]
+            return np.where(self.codes < 0, NULL_KEY_REP, reps)
+        v = self.values
+        if v.dtype.kind == "f":
+            rep = v.astype(np.float64).view(np.int64)
+            # canonicalize NaNs and -0.0 so equal-by-value keys group
+            rep = np.where(np.isnan(v), np.int64(0x7FF8000000000000), rep)
+            rep = np.where(v == 0.0, np.int64(0), rep)
+        elif v.dtype.kind == "b":
+            rep = v.astype(np.int64)
+        elif v.dtype.kind == "u":
+            rep = v.astype(np.uint64).view(np.int64)
+        else:
+            rep = v.astype(np.int64)
+        if self.validity is not None:
+            rep = np.where(self.validity, rep, NULL_KEY_REP)
+        return rep
+
+    # -- row ops ------------------------------------------------------------
+    def take(self, idx: np.ndarray) -> "Column":
+        if self.kind == "string":
+            return Column(
+                "string", self.arrow_type, codes=self.codes[idx],
+                dictionary=self.dictionary,
+            )
+        return Column(
+            "numeric",
+            self.arrow_type,
+            values=self.values[idx],
+            validity=None if self.validity is None else self.validity[idx],
+        )
+
+    @staticmethod
+    def concat(cols: Sequence["Column"]) -> "Column":
+        first = cols[0]
+        if len(cols) == 1:
+            return first
+        if first.kind == "string":
+            # Re-map codes into a shared dictionary.
+            merged: Dict[str, int] = {}
+            parts = []
+            for c in cols:
+                remap = np.empty(max(len(c.dictionary), 1), dtype=np.int32)
+                for i, s in enumerate(c.dictionary):
+                    remap[i] = merged.setdefault(s, len(merged))
+                part = np.where(c.codes < 0, -1, remap[np.maximum(c.codes, 0)])
+                parts.append(part.astype(np.int32))
+            return Column(
+                "string",
+                first.arrow_type,
+                codes=np.concatenate(parts),
+                dictionary=list(merged.keys()),
+            )
+        any_validity = any(c.validity is not None for c in cols)
+        validity = (
+            np.concatenate(
+                [
+                    c.validity
+                    if c.validity is not None
+                    else np.ones(len(c), dtype=bool)
+                    for c in cols
+                ]
+            )
+            if any_validity
+            else None
+        )
+        return Column(
+            "numeric",
+            first.arrow_type,
+            values=np.concatenate([c.values for c in cols]),
+            validity=validity,
+        )
+
+
+def _numpy_dtype_for(t: pa.DataType):
+    try:
+        return t.to_pandas_dtype()
+    except Exception:
+        return np.int64
+
+
+class ColumnarBatch:
+    """Ordered name → :class:`Column` mapping with row-aligned columns."""
+
+    def __init__(self, columns: Dict[str, Column]):
+        self.columns: Dict[str, Column] = dict(columns)
+        lens = {len(c) for c in self.columns.values()}
+        if len(lens) > 1:
+            raise HyperspaceException(f"Ragged columnar batch: lengths {lens}")
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def from_arrow(table: pa.Table) -> "ColumnarBatch":
+        return ColumnarBatch(
+            {name: Column.from_arrow(table.column(name)) for name in table.column_names}
+        )
+
+    def to_arrow(self) -> pa.Table:
+        return pa.table({n: c.to_arrow() for n, c in self.columns.items()})
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def num_rows(self) -> int:
+        if not self.columns:
+            return 0
+        return len(next(iter(self.columns.values())))
+
+    @property
+    def column_names(self) -> List[str]:
+        return list(self.columns)
+
+    def column(self, name: str) -> Column:
+        if name not in self.columns:
+            raise HyperspaceException(
+                f"Column {name!r} not in batch ({self.column_names})"
+            )
+        return self.columns[name]
+
+    # -- ops ----------------------------------------------------------------
+    def select(self, names: Sequence[str]) -> "ColumnarBatch":
+        return ColumnarBatch({n: self.column(n) for n in names})
+
+    def with_column(self, name: str, col: Column) -> "ColumnarBatch":
+        d = dict(self.columns)
+        d[name] = col
+        return ColumnarBatch(d)
+
+    def take(self, idx: np.ndarray) -> "ColumnarBatch":
+        return ColumnarBatch({n: c.take(idx) for n, c in self.columns.items()})
+
+    def filter(self, mask: np.ndarray) -> "ColumnarBatch":
+        return self.take(np.nonzero(np.asarray(mask))[0])
+
+    def key_reps(self, names: Sequence[str]) -> np.ndarray:
+        """[num_keys, num_rows] int64 key representations."""
+        return np.stack([self.column(n).key_rep() for n in names])
+
+    @staticmethod
+    def concat(batches: Sequence["ColumnarBatch"]) -> "ColumnarBatch":
+        if not batches:
+            raise HyperspaceException("Cannot concat zero batches")
+        non_empty = [b for b in batches if b.num_rows]
+        batches = non_empty or [batches[0]]
+        names = batches[0].column_names
+        for b in batches[1:]:
+            if b.column_names != names:
+                raise HyperspaceException(
+                    f"Schema mismatch in concat: {names} vs {b.column_names}"
+                )
+        return ColumnarBatch(
+            {n: Column.concat([b.column(n) for b in batches]) for n in names}
+        )

@@ -1,0 +1,110 @@
+"""Parquet read/write (host side, Arrow).
+
+The reference reads/writes through Spark's datasource machinery
+(``index/DataFrameWriterExtensions.scala:50-80`` for the bucketed index
+write, ``FileSourceScanExec`` for reads). Here the host does Arrow I/O and
+hands SoA batches to the device; the bucketed write emits **one parquet
+file per bucket** named like Spark's bucketed layout
+(``part-<fileidx>-…_<bucket>.c000.parquet``) so bucket ids are recoverable
+from file names at query time (the reference relies on
+``BucketingUtils.getBucketId``, ``actions/OptimizeAction.scala:110``).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.csv as pacsv
+import pyarrow.json as pajson
+import pyarrow.parquet as pq
+
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.io.columnar import ColumnarBatch
+
+_BUCKET_FILE_RE = re.compile(r"part-\d+-bucket_(\d+)\.parquet$")
+
+
+def read_table(
+    paths: Sequence[str], columns: Optional[Sequence[str]] = None, fmt: str = "parquet"
+) -> pa.Table:
+    """Read and concatenate files into one Arrow table."""
+    tables = []
+    for p in paths:
+        if fmt == "parquet":
+            tables.append(pq.read_table(p, columns=list(columns) if columns else None))
+        elif fmt == "csv":
+            t = pacsv.read_csv(p)
+            tables.append(t.select(list(columns)) if columns else t)
+        elif fmt == "json":
+            t = pajson.read_json(p)
+            tables.append(t.select(list(columns)) if columns else t)
+        else:
+            raise HyperspaceException(f"Unsupported format: {fmt}")
+    if not tables:
+        raise HyperspaceException("No files to read")
+    return pa.concat_tables(tables, promote_options="permissive")
+
+
+def read_batch(
+    paths: Sequence[str], columns: Optional[Sequence[str]] = None, fmt: str = "parquet"
+) -> ColumnarBatch:
+    return ColumnarBatch.from_arrow(read_table(paths, columns, fmt))
+
+
+def list_format_files(root: str, fmt: str = "parquet") -> List[str]:
+    """Leaf data files of a dataset directory (recursive, with the same
+    hidden-path filtering Spark's ``DataPathFilter`` applies)."""
+    from hyperspace_tpu.utils.files import list_leaf_files
+
+    ext = {"parquet": ".parquet", "csv": ".csv", "json": ".json"}[fmt]
+    return sorted(p for p, _s, _m in list_leaf_files(root, suffix=ext, data_only=True))
+
+
+def bucket_file_name(file_idx: int, bucket: int) -> str:
+    return f"part-{file_idx:05d}-bucket_{bucket:05d}.parquet"
+
+
+def bucket_id_of_file(path: str) -> Optional[int]:
+    m = _BUCKET_FILE_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def write_bucket_files(
+    out_dir: str,
+    bucket_ids: np.ndarray,
+    batch: ColumnarBatch,
+    num_buckets: int,
+    file_idx_offset: int = 0,
+) -> List[str]:
+    """Write rows (already grouped/sorted, see ``parallel/shuffle.py`` +
+    ``ops/sort.py``) as one parquet file per non-empty bucket."""
+    os.makedirs(out_dir, exist_ok=True)
+    table = batch.to_arrow()
+    written = []
+    # bucket_ids need not be globally sorted (shards interleave); find runs
+    # per bucket via argsort once.
+    order = np.argsort(bucket_ids, kind="stable")
+    sorted_ids = bucket_ids[order]
+    boundaries = np.nonzero(np.diff(sorted_ids))[0] + 1
+    starts = np.concatenate([[0], boundaries])
+    ends = np.concatenate([boundaries, [len(sorted_ids)]])
+    for s, e in zip(starts, ends):
+        if s == e:
+            continue
+        b = int(sorted_ids[s])
+        idx = order[s:e]
+        # rows within a bucket keep their (key-sorted) relative order
+        idx = np.sort(idx)
+        path = os.path.join(out_dir, bucket_file_name(file_idx_offset + b, b))
+        pq.write_table(table.take(pa.array(idx)), path)
+        written.append(path)
+    return written
+
+
+def write_table(path: str, table: pa.Table) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    pq.write_table(table, path)

@@ -1,0 +1,20 @@
+"""Device (XLA/Pallas) kernels for the index data plane.
+
+Everything in this package is jit-compilable JAX: bucket hashing
+(:mod:`hyperspace_tpu.ops.hash`), packed-key sorting
+(:mod:`hyperspace_tpu.ops.sort`), z-address bit interleaving
+(:mod:`hyperspace_tpu.ops.zorder`) and bloom-filter build/probe
+(:mod:`hyperspace_tpu.ops.bloom`). These replace the row-pipeline work that
+the reference leaves to Spark executors (hash partitioning, sort-within-
+bucket, sketch aggregation).
+
+Dtype policy: hot kernels (hash, sort keys, z-address) run on 32-bit words
+— TPU VPUs are 32-bit and int64 is emulated — so int64 key reps are split
+into (lo, hi) uint32 planes at the host boundary. x64 is still enabled
+globally because payload columns (int64 values, file ids) must round-trip
+through device exchanges losslessly.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,55 @@
+"""Device sort — the sort-within-bucket step of the covering index build.
+
+Reference: the bucketed *sorted* write in
+``index/DataFrameWriterExtensions.scala:58-67`` (Spark sorts each bucket by
+the indexed columns before writing). Here the whole shard is sorted by
+``(bucket_id, key_0, key_1, …)`` in one XLA lexsort; the per-bucket runs
+are then contiguous and each bucket's parquet file is written from a slice.
+
+Sorting uses int64 key reps (``io/columnar.py``): an arbitrary-but-
+consistent total order, which is exactly what bucketed sort-merge joins
+need (both sides sort by the same function of the key values;
+``JoinIndexRule.scala:619-634``). Like the hash kernel, comparisons run on
+32-bit planes (TPU-native): each int64 key becomes (hi ^ signbit as uint32
+major, lo uint32 minor), which orders identically to signed int64.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import hyperspace_tpu.ops  # noqa: F401  (enables x64)
+
+_SIGN = np.uint32(0x80000000)
+
+
+def _order_words_np(key_reps: np.ndarray) -> np.ndarray:
+    """[k, n] int64 -> [2k, n] uint32 planes whose lexicographic order
+    (row 0 major) equals signed-int64 order of the keys."""
+    u = np.ascontiguousarray(key_reps).view(np.uint64)
+    lo = (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    hi = ((u >> np.uint64(32)).astype(np.uint32)) ^ _SIGN  # flip sign bit
+    return np.stack([w for pair in zip(hi, lo) for w in pair])
+
+
+@jax.jit
+def lexsort_indices(word_planes):
+    """[m, n] uint32 -> [n] permutation; primary key = row 0.
+
+    ``jnp.lexsort`` treats the *last* row as primary, so reverse.
+    """
+    return jnp.lexsort(word_planes[::-1])
+
+
+def sort_permutation(
+    key_reps: np.ndarray, bucket: np.ndarray | None = None
+) -> np.ndarray:
+    """Host entry: permutation sorting rows by (bucket, key_reps...)."""
+    planes = _order_words_np(key_reps.astype(np.int64, copy=False))
+    if bucket is not None:
+        planes = np.concatenate(
+            [bucket.astype(np.uint32)[None, :], planes]
+        )
+    return np.asarray(lexsort_indices(jnp.asarray(planes)))

@@ -1,0 +1,14 @@
+"""Distributed layer: device mesh + collectives.
+
+TPU-native replacement for the reference's reliance on Spark's shuffle /
+broadcast machinery (SURVEY §2.11, §5 "Distributed communication backend"):
+``shard_map`` + XLA collectives (``all_to_all`` for bucketing shuffles,
+``all_gather`` for broadcast/stats, ``psum`` for aggregates) over a
+``jax.sharding.Mesh`` whose axis rides ICI within a slice and DCN across
+hosts.
+"""
+
+from hyperspace_tpu.parallel.mesh import MeshRuntime, default_mesh
+from hyperspace_tpu.parallel.shuffle import bucket_shuffle
+
+__all__ = ["MeshRuntime", "default_mesh", "bucket_shuffle"]

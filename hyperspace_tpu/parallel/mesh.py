@@ -1,0 +1,41 @@
+"""Session device mesh.
+
+The reference's execution substrate is a Spark cluster (driver +
+executors); ours is a 1-D ``jax.sharding.Mesh`` over all addressable
+devices — the "executors" are mesh shards, the host Python process is the
+driver. Multi-host scaling is the same code: ``jax.devices()`` spans hosts
+under ``jax.distributed``, collectives ride ICI within a slice and DCN
+across slices.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+SHARD_AXIS = "shard"
+
+
+def default_mesh(devices: Optional[Sequence] = None) -> jax.sharding.Mesh:
+    devs = list(devices) if devices is not None else jax.devices()
+    return jax.sharding.Mesh(np.array(devs), (SHARD_AXIS,))
+
+
+class MeshRuntime:
+    """Lazily-built mesh owned by a session (one per HyperspaceSession)."""
+
+    def __init__(self, devices: Optional[Sequence] = None):
+        self._devices = devices
+        self._mesh: Optional[jax.sharding.Mesh] = None
+
+    @property
+    def mesh(self) -> jax.sharding.Mesh:
+        if self._mesh is None:
+            self._mesh = default_mesh(self._devices)
+        return self._mesh
+
+    @property
+    def num_shards(self) -> int:
+        return self.mesh.devices.size

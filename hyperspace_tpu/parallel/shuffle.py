@@ -1,0 +1,127 @@
+"""Bucketing shuffle: ``shard_map`` + ``lax.all_to_all`` over the mesh.
+
+TPU-native replacement for the Spark hash-partition shuffle at the heart of
+the covering-index build (reference:
+``index/covering/CoveringIndex.scala:58-61`` ``repartition(numBuckets,
+indexedCols)`` and the Hybrid-Scan on-the-fly shuffle,
+``covering/CoveringIndexRuleUtils.scala:357-417``).
+
+Each device hashes its local rows to buckets (``ops/hash.py``), routes rows
+to the device that owns the bucket (``bucket % D``), and exchanges them in
+ONE ``all_to_all`` over the ICI ring. Since XLA programs need static
+shapes, each device sends a fixed-capacity ``[D, n_local]`` buffer per peer
+plus a validity mask; the host compacts valid rows after the exchange.
+(For >HBM datasets the same exchange runs in waves over chunked host
+batches — the reference leans on Spark's disk-backed shuffle for this;
+our wave loop lives in ``indexes/covering_build.py``.)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from hyperspace_tpu.ops.hash import hash_columns
+from hyperspace_tpu.parallel.mesh import SHARD_AXIS
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "num_buckets", "num_payload", "seed")
+)
+def _shuffle_program(mesh, key_reps, valid, payloads, num_buckets, num_payload, seed):
+    """The compiled multi-chip shuffle. Shapes: key_reps [k, N], valid [N],
+    payloads tuple of [N]-arrays; N divisible by D = mesh size."""
+    del num_payload  # encoded in payloads pytree structure
+    D = mesh.devices.size
+
+    def local(reps, vld, cols):
+        n = reps.shape[1]
+        bucket = (hash_columns(reps, seed) % jnp.uint32(num_buckets)).astype(
+            jnp.int32
+        )
+        dest = bucket % D
+        order = jnp.argsort(dest, stable=True)
+        dest_s = dest[order]
+        counts = jnp.bincount(dest_s, length=D)
+        offsets = jnp.concatenate(
+            [jnp.zeros(1, dtype=counts.dtype), jnp.cumsum(counts)[:-1]]
+        )
+        rank = jnp.arange(n) - offsets[dest_s]
+
+        def scatter(col, fill=0):
+            buf = jnp.full((D, n), fill, dtype=col.dtype)
+            return buf.at[dest_s, rank].set(col[order])
+
+        exchange = lambda x: lax.all_to_all(x, SHARD_AXIS, 0, 0, tiled=True)
+        recv_bucket = exchange(scatter(bucket))
+        recv_valid = exchange(scatter(vld.astype(jnp.bool_), fill=False))
+        recv_cols = tuple(exchange(scatter(c)) for c in cols)
+        # Flatten the per-peer dimension; sort locally by (valid desc,
+        # bucket, keys) so each bucket is one contiguous run and invalid
+        # slots sink to the tail.
+        flat_bucket = recv_bucket.reshape(-1)
+        flat_valid = recv_valid.reshape(-1)
+        flat_cols = tuple(c.reshape(-1) for c in recv_cols)
+        sort_bucket = jnp.where(flat_valid, flat_bucket, jnp.int32(num_buckets))
+        perm = jnp.argsort(sort_bucket, stable=True)
+        return (
+            flat_bucket[perm],
+            flat_valid[perm],
+            tuple(c[perm] for c in flat_cols),
+        )
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(None, SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+    )(key_reps, valid, payloads)
+
+
+def bucket_shuffle(
+    mesh,
+    key_reps: np.ndarray,
+    payloads: Sequence[np.ndarray],
+    num_buckets: int,
+    seed: int = 42,
+) -> Tuple[np.ndarray, List[np.ndarray]]:
+    """Host entry: shuffle rows into bucket-contiguous order across the mesh.
+
+    Returns ``(bucket_ids, payload_cols)`` with all rows grouped by bucket
+    (global order: all rows of buckets owned by shard 0, then shard 1, …;
+    within a shard, ascending bucket id). The caller does the final
+    within-bucket key sort (``ops/sort.py``) before writing.
+    """
+    D = mesh.devices.size
+    n = key_reps.shape[1]
+    pad = (-n) % D
+    if pad:
+        key_reps = np.pad(key_reps, ((0, 0), (0, pad)))
+        payloads = [np.pad(p, (0, pad)) for p in payloads]
+    valid = np.ones(n + pad, dtype=bool)
+    if pad:
+        valid[n:] = False
+    bucket, vmask, cols = _shuffle_program(
+        mesh,
+        jnp.asarray(key_reps),
+        jnp.asarray(valid),
+        tuple(jnp.asarray(p) for p in payloads),
+        num_buckets,
+        len(payloads),
+        seed,
+    )
+    bucket = np.asarray(bucket)
+    vmask = np.asarray(vmask)
+    keep = np.nonzero(vmask)[0]
+    return bucket[keep], [np.asarray(c)[keep] for c in cols]

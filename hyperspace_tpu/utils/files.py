@@ -91,15 +91,29 @@ def delete(path: str) -> None:
             pass
 
 
-def list_leaf_files(root: str) -> List[Tuple[str, int, int]]:
+def list_leaf_files(
+    root: str, suffix: str = "", data_only: bool = False
+) -> List[Tuple[str, int, int]]:
     """Recursive listing of (path, size, mtime_ms) for all regular files.
 
     Equivalent to the recursive ``listStatus`` in
-    ``Content.fromDirectory`` (IndexLogEntry.scala:86-96).
+    ``Content.fromDirectory`` (IndexLogEntry.scala:86-96). With
+    ``data_only`` the walk skips hidden/metadata paths the way Spark's
+    ``DataPathFilter`` does (``util/PathUtils.scala``); ``suffix`` filters
+    by file extension. This is the single walker — callers must not grow
+    their own ``os.walk`` so the hidden-path policy stays in one place.
     """
+    from hyperspace_tpu.utils.paths import is_data_path
+
     out: List[Tuple[str, int, int]] = []
-    for dirpath, _dirnames, filenames in os.walk(root):
+    for dirpath, dirnames, filenames in os.walk(root):
+        if data_only:
+            dirnames[:] = [d for d in dirnames if is_data_path(d)]
         for name in sorted(filenames):
+            if suffix and not name.endswith(suffix):
+                continue
+            if data_only and not is_data_path(name):
+                continue
             p = os.path.join(dirpath, name)
             try:
                 st = os.stat(p)

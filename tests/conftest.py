@@ -8,13 +8,21 @@ equivalent of the reference's ``local[4]`` Spark test sessions
 
 import os
 
-# Must happen before any jax import anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must happen before any jax import anywhere in the test process. Force CPU
+# even when the ambient environment points at a real TPU (JAX_PLATFORMS=axon)
+# — tests simulate the mesh with 8 virtual host devices. The env var alone
+# is not enough (a platform plugin pre-sets jax_platforms), so also override
+# the config after import, before any backend is initialized.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
