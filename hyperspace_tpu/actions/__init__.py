@@ -1,0 +1,13 @@
+"""Action / lifecycle layer (L2): the index state machine.
+
+Reference: ``src/main/scala/com/microsoft/hyperspace/actions/`` — every
+mutation of an index runs as an Action with the begin/op/end protocol over
+the operation log (``Action.scala:34-108``): write log id ``base+1`` with a
+transient state, run the data-plane op, write ``base+2`` with the final
+state and refresh ``latestStable``. Optimistic concurrency comes from
+``write_log`` failing when the id already exists.
+"""
+
+from hyperspace_tpu.actions.base import Action
+
+__all__ = ["Action"]

@@ -1,0 +1,92 @@
+"""DataFrame API — the user-facing query surface.
+
+Mirrors the subset of Spark's DataFrame the reference operates on
+(scan/filter/project/join; ``docs`` examples and
+``python/hyperspace/hyperspace.py`` drive exactly these). A DataFrame is a
+(session, logical plan) pair; ``collect()`` runs the session's optimizer —
+where index rewrites happen when ``enable_hyperspace()`` is on, like the
+reference's injected ``ApplyHyperspace`` rule (``package.scala:82-93``) —
+then the executor.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import pyarrow as pa
+
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.plan import expressions as E
+from hyperspace_tpu.plan.nodes import Filter, Join, LogicalPlan, Project, Scan
+
+
+class DataFrame:
+    def __init__(self, session, plan: LogicalPlan):
+        self._session = session
+        self._plan = plan
+
+    # -- schema surface -----------------------------------------------------
+    @property
+    def columns(self) -> List[str]:
+        return self._plan.output
+
+    def schema(self):
+        return self._plan.schema()
+
+    @property
+    def logical_plan(self) -> LogicalPlan:
+        return self._plan
+
+    def __getitem__(self, name: str) -> E.Col:
+        if name not in self._plan.output:
+            raise HyperspaceException(
+                f"No such column {name!r}; available: {self._plan.output}"
+            )
+        return E.Col(name)
+
+    # -- transformations ----------------------------------------------------
+    def filter(self, condition: E.Expr) -> "DataFrame":
+        if not isinstance(condition, E.Expr):
+            raise HyperspaceException("filter() takes an expression")
+        return DataFrame(self._session, Filter(condition, self._plan))
+
+    where = filter
+
+    def select(self, *columns: str) -> "DataFrame":
+        cols = list(
+            columns[0]
+            if len(columns) == 1 and isinstance(columns[0], (list, tuple))
+            else columns
+        )
+        return DataFrame(self._session, Project(cols, self._plan))
+
+    def join(
+        self,
+        other: "DataFrame",
+        on: Union[E.Expr, str, Sequence[str]],
+        how: str = "inner",
+    ) -> "DataFrame":
+        if isinstance(on, (str, list, tuple)):
+            raise HyperspaceException(
+                "Same-name join keys are ambiguous in this IR; "
+                "join with an expression like left['a'] == right['b']"
+            )
+        return DataFrame(self._session, Join(self._plan, other._plan, on, how))
+
+    # -- actions ------------------------------------------------------------
+    def collect(self) -> pa.Table:
+        return self._session.execute(self._plan)
+
+    def to_arrow(self) -> pa.Table:
+        return self.collect()
+
+    def count(self) -> int:
+        return self.collect().num_rows
+
+    def explain(self) -> str:
+        """Optimized plan string (for the full with/without-index diff use
+        ``Hyperspace.explain``)."""
+        return self._session.optimize(self._plan).pretty()
+
+    def __repr__(self):
+        return f"DataFrame[{', '.join(self.columns)}]"

@@ -1,0 +1,11 @@
+"""Physical execution: host-orchestrated, device-computed.
+
+The reference's physical layer is Spark's (``FileSourceScanExec``, SMJ,
+plus its own ``BucketUnionExec``, ``execution/BucketUnionExec.scala``).
+Here the host walks the logical plan, streams Arrow batches, and calls the
+XLA kernels in :mod:`hyperspace_tpu.ops` for predicates, joins and sorts.
+"""
+
+from hyperspace_tpu.execution.executor import execute
+
+__all__ = ["execute"]

@@ -1,0 +1,107 @@
+"""Plan executor: walks the (optimized) logical plan and produces Arrow.
+
+Equivalent role to Spark's physical planning + execution under the
+reference (scan → FileSourceScanExec etc.). Column pruning is pushed into
+the scan (the reference gets this from Parquet + Catalyst for free);
+predicates are evaluated with the XLA kernel (``ops/filter.py``) with a
+host fallback for expressions the device path does not cover.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Set
+
+import numpy as np
+
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.io import parquet as pio
+from hyperspace_tpu.io.columnar import ColumnarBatch
+from hyperspace_tpu.ops.filter import Unsupported, device_filter_mask
+from hyperspace_tpu.plan import expressions as E
+from hyperspace_tpu.plan.nodes import (
+    Filter,
+    Join,
+    LogicalPlan,
+    Project,
+    Scan,
+    Union,
+)
+
+
+def execute(plan: LogicalPlan, session=None):
+    """Execute -> pyarrow.Table (column order = plan.output)."""
+    batch = _exec(plan, set(plan.output), session)
+    return batch.select(plan.output).to_arrow()
+
+
+def _exec(plan: LogicalPlan, needed: Set[str], session) -> ColumnarBatch:
+    if isinstance(plan, Scan):
+        return _exec_scan(plan, needed, session)
+    if isinstance(plan, Filter):
+        child_needed = set(needed) | E.references(plan.condition)
+        batch = _exec(plan.child, child_needed, session)
+        return batch.filter(_filter_mask(plan.condition, batch))
+    if isinstance(plan, Project):
+        batch = _exec(plan.child, set(plan.columns), session)
+        return batch.select(plan.columns)
+    if isinstance(plan, Union):
+        cols = [c for c in plan.output if c in needed] or plan.output[:1]
+        left = _exec(plan.left, set(cols), session).select(cols)
+        right = _exec(plan.right, set(cols), session).select(cols)
+        return ColumnarBatch.concat([left, right])
+    if isinstance(plan, Join):
+        pairs = E.equi_join_pairs(plan.condition)
+        if pairs is None:
+            raise HyperspaceException(
+                f"Only conjunctive equi-joins are executable: {plan.condition!r}"
+            )
+        lcols = set(plan.left.output)
+        on = []
+        for a, b in pairs:
+            if a in lcols:
+                on.append((a, b))
+            else:
+                on.append((b, a))
+        l_needed = (needed & lcols) | {l for l, _ in on}
+        rcols = set(plan.right.output)
+        r_needed = (needed & rcols) | {r for _, r in on}
+        left = _exec(plan.left, l_needed, session)
+        right = _exec(plan.right, r_needed, session)
+        from hyperspace_tpu.execution.join_exec import inner_join
+
+        return inner_join(left, right, on)
+    raise HyperspaceException(f"Unknown plan node: {type(plan).__name__}")
+
+
+def _filter_mask(cond: E.Expr, batch: ColumnarBatch) -> np.ndarray:
+    try:
+        return device_filter_mask(cond, batch)
+    except Unsupported:
+        return E.filter_mask(cond, batch)
+
+
+def _exec_scan(plan: Scan, needed: Set[str], session) -> ColumnarBatch:
+    rel = plan.relation
+    cols = [c for c in rel.column_names if c in needed] or rel.column_names[:1]
+    read_cols = list(cols)
+    # Hybrid-Scan delete compensation: the lineage column must be read to
+    # apply the NOT-IN filter (CoveringIndexRuleUtils.scala:244-253), even
+    # if the query does not project it.
+    from hyperspace_tpu.constants import DATA_FILE_NAME_ID
+
+    if rel.excluded_file_ids is not None and DATA_FILE_NAME_ID not in read_cols:
+        read_cols.append(DATA_FILE_NAME_ID)
+    if not rel.files:
+        import pyarrow as pa
+
+        empty = pa.table(
+            {c: pa.array([], type=rel.schema[c]) for c in cols}
+        )
+        return ColumnarBatch.from_arrow(empty)
+    table = pio.read_table(list(rel.files), read_cols, rel.fmt)
+    batch = ColumnarBatch.from_arrow(table)
+    if rel.excluded_file_ids is not None:
+        lineage = batch.column(DATA_FILE_NAME_ID).values
+        mask = ~np.isin(lineage, np.array(rel.excluded_file_ids, dtype=np.int64))
+        batch = batch.filter(mask)
+    return batch.select(cols)

@@ -1,0 +1,94 @@
+"""Hyperspace — the user-facing API facade.
+
+Reference: ``Hyperspace.scala:27-193`` and its Python binding
+(``python/hyperspace/hyperspace.py:9-192``). Every method delegates to the
+collection manager (actions) or the plan-analysis tooling; index
+maintenance runs with the query-rewrite rule disabled so maintenance scans
+never get rewritten to use the index being maintained
+(``ApplyHyperspace.withHyperspaceRuleDisabled``,
+rules/ApplyHyperspace.scala:68-75).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import pyarrow as pa
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.constants import States
+from hyperspace_tpu.exceptions import HyperspaceException
+
+
+class Hyperspace:
+    def __init__(self, session):
+        self.session = session
+        self._manager = session.index_manager
+
+    # -- index CRUD (Hyperspace.scala:43-151) -------------------------------
+    def create_index(self, df, index_config) -> None:
+        with self._maintenance():
+            self._manager.create(df, index_config)
+
+    def delete_index(self, index_name: str) -> None:
+        with self._maintenance():
+            self._manager.delete(index_name)
+
+    def restore_index(self, index_name: str) -> None:
+        with self._maintenance():
+            self._manager.restore(index_name)
+
+    def vacuum_index(self, index_name: str) -> None:
+        with self._maintenance():
+            self._manager.vacuum(index_name)
+
+    def refresh_index(self, index_name: str, mode: str = C.REFRESH_MODE_FULL) -> None:
+        with self._maintenance():
+            self._manager.refresh(index_name, mode)
+
+    def optimize_index(
+        self, index_name: str, mode: str = C.OPTIMIZE_MODE_QUICK
+    ) -> None:
+        with self._maintenance():
+            self._manager.optimize(index_name, mode)
+
+    def cancel(self, index_name: str) -> None:
+        with self._maintenance():
+            self._manager.cancel(index_name)
+
+    def _maintenance(self):
+        from hyperspace_tpu.rules.apply import hyperspace_rule_disabled
+
+        return hyperspace_rule_disabled()
+
+    # -- introspection (Hyperspace.scala:33-41, 153-193) --------------------
+    def indexes(self) -> pa.Table:
+        """Summary DataFrame of all indexes (IndexStatistics summary columns,
+        index/IndexStatistics.scala:58-60)."""
+        from hyperspace_tpu.plananalysis.statistics import indexes_summary_table
+
+        return indexes_summary_table(self._manager.get_indexes())
+
+    def index(self, index_name: str) -> pa.Table:
+        """Extended statistics for one index (Hyperspace.scala:153-158)."""
+        from hyperspace_tpu.plananalysis.statistics import index_stats_table
+
+        entry = self._manager.get_index_log_entry(index_name)
+        if entry is None or entry.state == States.DOESNOTEXIST:
+            raise HyperspaceException(f"Index not found: {index_name!r}")
+        return index_stats_table(entry)
+
+    def explain(self, df, verbose: bool = False) -> str:
+        """Plan diff with vs without Hyperspace (PlanAnalyzer.explainString)."""
+        from hyperspace_tpu.plananalysis.explain import explain_string
+
+        return explain_string(df, self.session, self._manager, verbose)
+
+    def why_not(
+        self, df, index_name: Optional[str] = None, extended: bool = False
+    ) -> str:
+        """Why indexes were not applied to df's plan
+        (CandidateIndexAnalyzer.whyNotIndexString:30-43)."""
+        from hyperspace_tpu.plananalysis.why_not import why_not_string
+
+        return why_not_string(df, self.session, self._manager, index_name, extended)

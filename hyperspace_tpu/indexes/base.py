@@ -95,3 +95,9 @@ class IndexConfigTrait(abc.ABC):
     def create_index(self, ctx, source_data, properties: Dict[str, str]):
         """Return ``(Index, index_data)`` — the index object and the data to
         write (IndexConfigTrait.createIndex)."""
+
+    def describe_index(self, ctx, source_data, properties: Dict[str, str]):
+        """The Index object alone, WITHOUT building index data — used for
+        the begin-phase (transient-state) log entry, which is written
+        before any data exists."""
+        raise NotImplementedError

@@ -1,0 +1,24 @@
+"""IndexerContext — everything an index build step needs.
+
+Reference: ``index/IndexerContext.scala:25-43`` (spark session, shared
+FileIdTracker, index data path). Ours adds the device mesh (the session's
+runtime) since the build pipeline runs on it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from hyperspace_tpu.metadata.entry import FileIdTracker
+
+
+@dataclasses.dataclass
+class IndexerContext:
+    session: object
+    file_id_tracker: FileIdTracker
+    index_data_path: str
+
+    @property
+    def mesh(self):
+        return self.session.runtime.mesh

@@ -208,3 +208,11 @@ class CoveringIndexConfig(IndexConfigTrait):
         return covering_build.create_covering_index(
             ctx, source_data, self, properties
         )
+
+    def describe_index(self, ctx, source_data, properties: Dict[str, str]):
+        """CoveringIndex object without scanning data (begin-phase entry)."""
+        from hyperspace_tpu.indexes import covering_build
+
+        return covering_build.describe_covering_index(
+            ctx, source_data, self, properties
+        )

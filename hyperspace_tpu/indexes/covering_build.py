@@ -1,0 +1,295 @@
+"""Covering-index build pipeline (device data plane).
+
+TPU-native re-design of ``CoveringIndex.createIndexData:140-192`` +
+``write:56-71`` + ``CoveringIndexTrait`` refresh/optimize (:32-135):
+
+    host scan (arrow, per source file)  →  SoA batches w/ lineage column
+      →  murmur3 bucket hash                      [ops/hash, XLA]
+      →  all-to-all over the mesh (>1 device)     [parallel/shuffle]
+      →  lexsort by (bucket, keys)                [ops/sort, XLA]
+      →  one parquet file per bucket under the new v__=N dir
+
+Lineage (`_data_file_id`) is attached as a constant int64 column per source
+file during the scan — the moral equivalent of the reference's
+``input_file_name()`` ⋈ broadcast(fileId map) join
+(CoveringIndex.scala:177-186) without needing a join at all, because our
+scan is already per-file.
+
+Single-host note: after the device exchange all shards live in this
+process, so one host writes every bucket. On a multi-host mesh each host
+writes only the buckets its local shards own; the layout (one file per
+bucket, bucket id in the file name) is identical.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from hyperspace_tpu.constants import DATA_FILE_NAME_ID, LINEAGE_PROPERTY
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.indexes.base import UpdateMode
+from hyperspace_tpu.io import parquet as pio
+from hyperspace_tpu.io.columnar import Column, ColumnarBatch
+from hyperspace_tpu.ops.hash import bucket_ids_np
+from hyperspace_tpu.ops.sort import sort_permutation
+from hyperspace_tpu.utils import resolver
+
+
+# ---------------------------------------------------------------------------
+# Scan side: build index data from source files
+# ---------------------------------------------------------------------------
+
+
+def _scan_with_lineage(
+    files: Sequence[str],
+    fmt: str,
+    columns: List[str],
+    file_ids: Optional[Dict[str, int]],
+) -> ColumnarBatch:
+    """Read the projection from each source file; attach `_data_file_id`
+    when lineage is on (CoveringIndex.createIndexData:177-186)."""
+    batches = []
+    for f in files:
+        t = pio.read_table([f], columns, fmt)
+        b = ColumnarBatch.from_arrow(t)
+        if file_ids is not None:
+            fid = np.full(b.num_rows, file_ids[f], dtype=np.int64)
+            b = b.with_column(
+                DATA_FILE_NAME_ID, Column("numeric", pa.int64(), values=fid)
+            )
+        batches.append(b)
+    if not batches:
+        raise HyperspaceException("No source files to index")
+    return ColumnarBatch.concat(batches)
+
+
+def resolve_index_schema(rel, config, properties: Dict[str, str]):
+    """(indexed, included, lineage, schema_json) — shared by data-building
+    ``create_covering_index`` and data-free ``describe_index`` so the
+    begin-phase and final log entries can never diverge."""
+    import json
+
+    indexed = [
+        rc.name
+        for rc in resolver.require_resolve(config.indexed_columns, rel.column_names)
+    ]
+    included = [
+        rc.name
+        for rc in resolver.require_resolve(config.included_columns, rel.column_names)
+    ]
+    lineage = str(properties.get(LINEAGE_PROPERTY, "false")).lower() == "true"
+    schema = rel.schema
+    schema_json = json.dumps(
+        [[c, str(schema[c])] for c in indexed + included]
+        + ([[DATA_FILE_NAME_ID, "int64"]] if lineage else [])
+    )
+    return indexed, included, lineage, schema_json
+
+
+def describe_covering_index(ctx, source_df, config, properties: Dict[str, str]):
+    """CoveringIndex object without scanning data (begin-phase log entry)."""
+    from hyperspace_tpu.indexes.covering import CoveringIndex
+
+    rel = _single_relation(source_df)
+    indexed, included, _lineage, schema_json = resolve_index_schema(
+        rel, config, properties
+    )
+    return CoveringIndex(
+        indexed, included, schema_json, ctx.session.conf.num_buckets,
+        dict(properties),
+    )
+
+
+def _single_relation(source_df):
+    leaves = source_df.logical_plan.collect_leaves()
+    if len(leaves) != 1:
+        raise HyperspaceException(
+            f"Index source must have exactly one relation; got {len(leaves)}"
+        )
+    return leaves[0].relation
+
+
+def create_covering_index(ctx, source_df, config, properties: Dict[str, str]):
+    """(CoveringIndex, index_data batch) — the reference's
+    ``CoveringIndexConfig.createIndex:43-61``."""
+    from hyperspace_tpu.indexes.covering import CoveringIndex
+
+    rel = _single_relation(source_df)
+    indexed, included, lineage, schema_json = resolve_index_schema(
+        rel, config, properties
+    )
+    file_ids = None
+    if lineage:
+        file_ids = {}
+        for path, size, mtime in _stat_files(rel.files):
+            file_ids[path] = ctx.file_id_tracker.add_file(path, size, mtime)
+    batch = _scan_with_lineage(rel.files, rel.fmt, indexed + included, file_ids)
+    index = CoveringIndex(
+        indexed_columns=indexed,
+        included_columns=included,
+        schema_json=schema_json,
+        num_buckets=ctx.session.conf.num_buckets,
+        properties=dict(properties),
+    )
+    return index, batch
+
+
+def _stat_files(files) -> List[Tuple[str, int, int]]:
+    import os
+
+    return [
+        (f, os.stat(f).st_size, int(os.stat(f).st_mtime * 1000)) for f in files
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Shuffle + sort + bucketed write
+# ---------------------------------------------------------------------------
+
+
+def _decompose(batch: ColumnarBatch):
+    """Flatten a batch into device-movable arrays + reassembly spec."""
+    arrays: List[np.ndarray] = []
+    spec = []
+    for name, col in batch.columns.items():
+        if col.kind == "string":
+            arrays.append(col.codes)
+            spec.append(("string", name, col.arrow_type, col.dictionary, False))
+        else:
+            arrays.append(col.values)
+            has_validity = col.validity is not None
+            if has_validity:
+                arrays.append(col.validity)
+            spec.append(("numeric", name, col.arrow_type, None, has_validity))
+    return arrays, spec
+
+
+def _reassemble(spec, arrays: List[np.ndarray]) -> ColumnarBatch:
+    cols = {}
+    it = iter(arrays)
+    for kind, name, atype, dictionary, has_validity in spec:
+        if kind == "string":
+            cols[name] = Column(
+                "string", atype, codes=next(it).astype(np.int32),
+                dictionary=dictionary,
+            )
+        else:
+            values = next(it)
+            validity = next(it) if has_validity else None
+            cols[name] = Column("numeric", atype, values=values, validity=validity)
+    return ColumnarBatch(cols)
+
+
+def bucketize(ctx, batch: ColumnarBatch, indexed_cols: List[str], num_buckets: int):
+    """Route rows to buckets -> (bucket_ids, batch) in bucket-grouped,
+    key-sorted order. Uses the mesh all-to-all when >1 device."""
+    reps = batch.key_reps(indexed_cols)
+    mesh = ctx.mesh
+    if mesh.devices.size > 1 and batch.num_rows >= mesh.devices.size:
+        from hyperspace_tpu.parallel.shuffle import bucket_shuffle
+
+        arrays, spec = _decompose(batch)
+        k = reps.shape[0]
+        buckets, moved = bucket_shuffle(
+            mesh, reps, list(reps) + arrays, num_buckets
+        )
+        reps = np.stack(moved[:k]) if k else np.zeros((0, len(buckets)))
+        batch = _reassemble(spec, moved[k:])
+    else:
+        buckets = bucket_ids_np(reps, num_buckets)
+    perm = sort_permutation(reps, buckets)
+    return buckets[perm], batch.take(perm)
+
+
+def write_bucketed(
+    ctx,
+    batch: ColumnarBatch,
+    indexed_cols: List[str],
+    num_buckets: int,
+    file_idx_offset: int = 0,
+) -> List[str]:
+    """The full build pipeline tail: shuffle, sort-within-bucket, write one
+    parquet per bucket (CoveringIndex.write:56-71 + saveWithBuckets)."""
+    if batch.num_rows == 0:
+        import os
+
+        os.makedirs(ctx.index_data_path, exist_ok=True)
+        return []
+    buckets, batch = bucketize(ctx, batch, indexed_cols, num_buckets)
+    return pio.write_bucket_files(
+        ctx.index_data_path, buckets, batch, num_buckets, file_idx_offset
+    )
+
+
+# ---------------------------------------------------------------------------
+# Optimize / refresh data plane
+# ---------------------------------------------------------------------------
+
+
+def rewrite_files(
+    ctx, files_to_optimize: List[str], indexed_cols: List[str], num_buckets: int
+) -> List[str]:
+    """Optimize: read the listed index files and rewrite them compacted
+    (CoveringIndexTrait.optimize:130-134 — 'read files → write')."""
+    batch = ColumnarBatch.from_arrow(pio.read_table(files_to_optimize, None))
+    return write_bucketed(ctx, batch, indexed_cols, num_buckets)
+
+
+def refresh_incremental(
+    ctx,
+    index,
+    appended_df,
+    deleted_source_file_ids: List[int],
+    previous_content,
+):
+    """CoveringIndexTrait.refreshIncremental:57-106.
+
+    * appended source files -> index their rows into the new version dir;
+    * deleted source files  -> previous index data rewritten minus rows
+      whose lineage id is among the deleted (anti-filter), also into the
+      new version dir.
+    Returns (index, UpdateMode.MERGE | OVERWRITE).
+    """
+    schema_cols = list(index.indexed_columns) + list(index.included_columns)
+    if index.lineage_enabled:
+        schema_cols.append(DATA_FILE_NAME_ID)
+    parts: List[ColumnarBatch] = []
+    if appended_df is not None:
+        _index2, appended_batch = create_covering_index(
+            ctx,
+            appended_df,
+            _config_of(index),
+            dict(index.properties),
+        )
+        parts.append(appended_batch.select(schema_cols))
+    if deleted_source_file_ids:
+        if not index.lineage_enabled:
+            raise HyperspaceException(
+                "Cannot handle deleted source files without lineage"
+            )
+        old = ColumnarBatch.from_arrow(
+            pio.read_table(list(previous_content.files), None)
+        )
+        lineage = old.column(DATA_FILE_NAME_ID).values
+        keep = ~np.isin(
+            lineage, np.array(deleted_source_file_ids, dtype=np.int64)
+        )
+        parts.append(old.filter(keep).select(schema_cols))
+        mode = UpdateMode.OVERWRITE
+    else:
+        mode = UpdateMode.MERGE
+    if parts:
+        batch = ColumnarBatch.concat(parts)
+        write_bucketed(ctx, batch, index.indexed_columns, index.num_buckets)
+    return index, mode
+
+
+def _config_of(index):
+    from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+
+    return CoveringIndexConfig(
+        "__refresh__", index.indexed_columns, index.included_columns
+    )

@@ -224,6 +224,21 @@ class Column:
         )
 
 
+def remap_codes(target_dictionary: List[str], col: "Column") -> np.ndarray:
+    """A string column's codes re-expressed in another dictionary's space.
+
+    Entries absent from ``target_dictionary`` map to -2, nulls to -3, so
+    the result is directly comparable against the target column's codes
+    (equal ⟺ same non-null string). Shared by cross-column string equality
+    (plan/expressions) and join key verification (execution/join_exec).
+    """
+    lut = {s: i for i, s in enumerate(target_dictionary)}
+    remap = np.array(
+        [lut.get(s, -2) for s in col.dictionary] or [-2], dtype=np.int64
+    )
+    return np.where(col.codes < 0, -3, remap[np.maximum(col.codes, 0)])
+
+
 def _numpy_dtype_for(t: pa.DataType):
     try:
         return t.to_pandas_dtype()

@@ -1,0 +1,236 @@
+"""XLA-compiled columnar predicate evaluation (the device twin of
+``plan/expressions.evaluate``).
+
+This is the scan-side filter kernel of the serve path (SURVEY §7 Phase 2:
+"XLA-compiled columnar filter kernel over index files"). The host prepares
+device-friendly inputs per batch:
+
+* numeric columns → their value arrays (+ validity);
+* string columns → per-row dictionary *rank* arrays (order-preserving
+  integers, host-computed O(unique) — see ``plan/expressions._StringRef``),
+  with string literals lowered to ``(bisect_left, bisect_right)`` rank
+  bounds. Every string predicate (=, <, IN, …) is thereby pure integer
+  arithmetic on device.
+
+The expression tree is lowered to a hashable *spec* (nested tuples) used as
+the jit static argument, so each predicate shape compiles once; literals
+and column arrays flow in as dynamic args (changing a literal or reading a
+different file does not recompile).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import hyperspace_tpu.ops  # noqa: F401  (enables x64)
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.plan import expressions as E
+
+
+class Unsupported(HyperspaceException):
+    """Expression not device-compilable; caller falls back to host eval."""
+
+
+class _Prep:
+    """Lowers an Expr over a given batch into (spec, args)."""
+
+    def __init__(self, batch):
+        self.batch = batch
+        self.args: List[Any] = []
+        self._col_slots = {}
+
+    def _arg(self, v) -> int:
+        self.args.append(v)
+        return len(self.args) - 1
+
+    def _col(self, name: str):
+        """-> ("col", values_slot, valid_slot|-1, kind)"""
+        if name in self._col_slots:
+            return self._col_slots[name]
+        col = self.batch.column(name)
+        if col.kind == "string":
+            ref = E._StringRef(col.codes, col.dictionary)
+            vals = self._arg(ref.rank_values().astype(np.int64))
+            valid = self._arg(ref.valid)
+            spec = ("col", vals, valid, "string", name)
+            self._col_slots[name] = (spec, ref)
+            return self._col_slots[name]
+        vals = self._arg(col.values)
+        valid = -1 if col.validity is None else self._arg(col.validity)
+        spec = ("col", vals, valid, "numeric", name)
+        self._col_slots[name] = (spec, None)
+        return self._col_slots[name]
+
+    def lower(self, e: E.Expr):
+        if isinstance(e, E.Lit):
+            if e.value is None:
+                return ("null",)
+            if not isinstance(e.value, (bool, np.bool_)):
+                raise Unsupported(f"Bare non-bool literal: {e!r}")
+            return ("const", bool(e.value))
+        if isinstance(e, (E.Eq, E.Ne, E.Lt, E.Le, E.Gt, E.Ge)):
+            op = e.op
+            left, right = e.left, e.right
+            flipped = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "!=": "!="}
+            if isinstance(left, E.Lit) and not isinstance(right, E.Lit):
+                left, right = right, left
+                op = flipped[op]
+            if isinstance(left, E.Col) and isinstance(right, E.Lit):
+                if right.value is None:
+                    return ("null",)
+                cspec, ref = self._col(left.name)
+                if ref is not None:  # string: literal -> rank bounds
+                    lo, hi = ref.rank_bounds(str(right.value))
+                    return (
+                        "cmp_str",
+                        op,
+                        cspec,
+                        self._arg(np.int64(lo)),
+                        self._arg(np.int64(hi)),
+                    )
+                return ("cmp_lit", op, cspec, self._arg(np.asarray(right.value)))
+            if isinstance(left, E.Col) and isinstance(right, E.Col):
+                lspec, lref = self._col(left.name)
+                rspec, rref = self._col(right.name)
+                if (lref is None) != (rref is None):
+                    raise Unsupported(f"Mixed-type column comparison: {e!r}")
+                if lref is not None:
+                    # ranks are per-column orders; cross-column string
+                    # comparison needs the host path
+                    raise Unsupported(f"String col-col comparison: {e!r}")
+                return ("cmp_col", op, lspec, rspec)
+            raise Unsupported(f"Comparison operands: {e!r}")
+        if isinstance(e, E.And):
+            return ("and", self.lower(e.left), self.lower(e.right))
+        if isinstance(e, E.Or):
+            return ("or", self.lower(e.left), self.lower(e.right))
+        if isinstance(e, E.Not):
+            return ("not", self.lower(e.child))
+        if isinstance(e, E.IsNull):
+            if not isinstance(e.child, E.Col):
+                raise Unsupported(f"IS NULL on non-column: {e!r}")
+            cspec, _ref = self._col(e.child.name)
+            return ("isnull", cspec)
+        if isinstance(e, E.In):
+            if not isinstance(e.child, E.Col):
+                raise Unsupported(f"IN on non-column: {e!r}")
+            cspec, ref = self._col(e.child.name)
+            vals = [v for v in e.values if v is not None]
+            if not vals:
+                # x IN () is never true (matches the host path's all-False)
+                return ("const", False)
+            if ref is not None:
+                ranks = []
+                for v in vals:
+                    lo, hi = ref.rank_bounds(str(v))
+                    if hi > lo:
+                        ranks.append(lo)
+                arr = np.array(sorted(ranks) or [-1], dtype=np.int64)
+            else:
+                try:
+                    arr = np.sort(np.array(vals))
+                except Exception as ex:  # mixed-type lits etc.
+                    raise Unsupported(f"IN literal set: {e!r}") from ex
+                if arr.dtype == object:
+                    raise Unsupported(f"IN literal set: {e!r}")
+            return ("in", cspec, self._arg(arr))
+        raise Unsupported(f"Expression not device-compilable: {e!r}")
+
+
+def _eval_spec(spec, args, n):
+    """Recursive jnp evaluation -> (values[bool n], valid[bool n])."""
+    kind = spec[0]
+    t = lambda: jnp.ones(n, dtype=bool)
+    if kind == "null":
+        return jnp.zeros(n, bool), jnp.zeros(n, bool)
+    if kind == "const":
+        return jnp.full(n, spec[1]), t()
+    if kind in ("cmp_lit", "cmp_col", "cmp_str"):
+        op = spec[1]
+        _c, vslot, valslot, _k, _name = spec[2]
+        v = args[vslot]
+        valid = t() if valslot == -1 else args[valslot]
+        if kind == "cmp_lit":
+            lit = args[spec[3]]
+            vals = _apply_cmp(op, v, lit)
+        elif kind == "cmp_str":
+            lo, hi = args[spec[3]], args[spec[4]]
+            vals = {
+                "=": (v >= lo) & (v < hi),
+                "!=": ~((v >= lo) & (v < hi)),
+                "<": v < lo,
+                "<=": v < hi,
+                ">": v >= hi,
+                ">=": v >= lo,
+            }[op]
+        else:
+            _c2, vslot2, valslot2, _k2, _n2 = spec[3]
+            v2 = args[vslot2]
+            valid = valid & (t() if valslot2 == -1 else args[valslot2])
+            vals = _apply_cmp(op, v, v2)
+        return vals, valid
+    if kind == "and":
+        lv, lk = _eval_spec(spec[1], args, n)
+        rv, rk = _eval_spec(spec[2], args, n)
+        vals = lv & rv & lk & rk
+        known = (lk & rk) | (lk & ~lv) | (rk & ~rv)
+        return vals, known
+    if kind == "or":
+        lv, lk = _eval_spec(spec[1], args, n)
+        rv, rk = _eval_spec(spec[2], args, n)
+        vals = (lv & lk) | (rv & rk)
+        known = (lk & rk) | (lk & lv) | (rk & rv)
+        return vals, known
+    if kind == "not":
+        v, k = _eval_spec(spec[1], args, n)
+        return ~v, k
+    if kind == "isnull":
+        _c, vslot, valslot, _k, _name = spec[1]
+        valid = t() if valslot == -1 else args[valslot]
+        return ~valid, t()
+    if kind == "in":
+        _c, vslot, valslot, _k, _name = spec[1]
+        v = args[vslot]
+        valid = t() if valslot == -1 else args[valslot]
+        lits = args[spec[2]]
+        # binary-search membership (SortedArrayLowerBound-style,
+        # dataskipping/expressions/SortedArrayLowerBound.scala)
+        pos = jnp.searchsorted(lits, v)
+        pos = jnp.clip(pos, 0, lits.shape[0] - 1)
+        vals = lits[pos] == v
+        return vals, valid
+    raise HyperspaceException(f"Bad spec node: {spec!r}")
+
+
+def _apply_cmp(op, a, b):
+    return {
+        "=": a == b,
+        "!=": a != b,
+        "<": a < b,
+        "<=": a <= b,
+        ">": a > b,
+        ">=": a >= b,
+    }[op]
+
+
+@functools.partial(jax.jit, static_argnames=("spec", "n"))
+def _run(spec, n, args: Tuple):
+    vals, valid = _eval_spec(spec, list(args), n)
+    return vals & valid
+
+
+def device_filter_mask(expr: E.Expr, batch) -> np.ndarray:
+    """Evaluate a predicate on device; raises :class:`Unsupported` when the
+    expression needs the host path (``plan/expressions.filter_mask``)."""
+    n = batch.num_rows
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    p = _Prep(batch)
+    spec = p.lower(expr)
+    args = tuple(jnp.asarray(a) for a in p.args)
+    return np.asarray(_run(spec, n, args))

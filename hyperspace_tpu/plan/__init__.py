@@ -1,0 +1,19 @@
+"""Logical plan IR.
+
+The reference injects rules into Spark's Catalyst optimizer
+(``rules/ApplyHyperspace.scala``); here we own the whole planner: a small
+relational IR (Scan/Filter/Project/Join — :mod:`hyperspace_tpu.plan.nodes`)
+with typed expressions (:mod:`hyperspace_tpu.plan.expressions`). Queries are
+built through the DataFrame API (:mod:`hyperspace_tpu.dataframe`), optimized
+by the rules in :mod:`hyperspace_tpu.rules`, and executed by
+:mod:`hyperspace_tpu.execution`.
+"""
+
+from hyperspace_tpu.plan import expressions as E  # noqa: F401
+from hyperspace_tpu.plan.nodes import (  # noqa: F401
+    Filter,
+    Join,
+    LogicalPlan,
+    Project,
+    Scan,
+)

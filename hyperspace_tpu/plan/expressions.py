@@ -1,0 +1,428 @@
+"""Typed expression tree + null-aware columnar evaluation.
+
+Plays the role of Catalyst expressions in the reference (predicates reach
+its rules as Spark ``Expression`` trees, e.g.
+``covering/FilterIndexRule.scala:62-103`` walks them for column coverage).
+Nodes are frozen dataclasses: hashable (planner memoization, jit static
+args) and comparable structurally.
+
+Evaluation is SQL three-valued logic over :class:`ColumnarBatch` columns:
+``evaluate`` returns ``(values, valid)`` numpy arrays; a filter keeps rows
+where ``values & valid``. String comparisons never touch bytes row-wise —
+equality/In compare dictionary codes, ordering comparisons compare
+per-batch *rank* arrays (dictionary sorted host-side once, O(unique)), so
+the same arithmetic runs on device codes (see ``ops/filter.py``, the
+XLA-compiled twin of this evaluator).
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+from typing import Any, FrozenSet, List, Optional, Set, Tuple, Union
+
+import numpy as np
+
+from hyperspace_tpu.exceptions import HyperspaceException
+
+# ---------------------------------------------------------------------------
+# Nodes
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Expr:
+    def __and__(self, other: "Expr") -> "Expr":
+        return And(self, _lit(other))
+
+    def __or__(self, other: "Expr") -> "Expr":
+        return Or(self, _lit(other))
+
+    def __invert__(self) -> "Expr":
+        return Not(self)
+
+    def __bool__(self):
+        # Col.__eq__ builds an Eq expression (DataFrame API), so Python
+        # equality on expression trees is NOT structural equality. Fail
+        # loudly instead of silently treating every comparison as truthy.
+        raise TypeError(
+            "Expression has no truth value; use semantic_equals() or repr()"
+        )
+
+
+def semantic_equals(a: Optional["Expr"], b: Optional["Expr"]) -> bool:
+    """Structural equality (repr is canonical for these frozen trees)."""
+    return repr(a) == repr(b)
+
+
+def _lit(v: Union["Expr", Any]) -> "Expr":
+    return v if isinstance(v, Expr) else Lit(v)
+
+
+@dataclasses.dataclass(frozen=True)
+class Col(Expr):
+    name: str
+
+    def __repr__(self):
+        return self.name
+
+    # comparison builders (DataFrame API surface)
+    def __eq__(self, other):  # type: ignore[override]
+        return Eq(self, _lit(other))
+
+    def __ne__(self, other):  # type: ignore[override]
+        return Ne(self, _lit(other))
+
+    def __lt__(self, other):
+        return Lt(self, _lit(other))
+
+    def __le__(self, other):
+        return Le(self, _lit(other))
+
+    def __gt__(self, other):
+        return Gt(self, _lit(other))
+
+    def __ge__(self, other):
+        return Ge(self, _lit(other))
+
+    def __hash__(self):
+        return hash(("Col", self.name))
+
+    def isin(self, *values) -> "In":
+        vals = values[0] if len(values) == 1 and isinstance(
+            values[0], (list, tuple, set)
+        ) else values
+        return In(self, tuple(sorted(set(vals), key=repr)))
+
+    def is_null(self) -> "IsNull":
+        return IsNull(self)
+
+    def is_not_null(self) -> "Not":
+        return Not(IsNull(self))
+
+
+@dataclasses.dataclass(frozen=True)
+class Lit(Expr):
+    value: Any
+
+    def __repr__(self):
+        return repr(self.value)
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class _Binary(Expr):
+    left: Expr
+    right: Expr
+
+    op = "?"
+
+    def __repr__(self):
+        return f"({self.left!r} {self.op} {self.right!r})"
+
+
+class Eq(_Binary):
+    op = "="
+
+
+class Ne(_Binary):
+    op = "!="
+
+
+class Lt(_Binary):
+    op = "<"
+
+
+class Le(_Binary):
+    op = "<="
+
+
+class Gt(_Binary):
+    op = ">"
+
+
+class Ge(_Binary):
+    op = ">="
+
+
+class And(_Binary):
+    op = "AND"
+
+
+class Or(_Binary):
+    op = "OR"
+
+
+@dataclasses.dataclass(frozen=True)
+class Not(Expr):
+    child: Expr
+
+    def __repr__(self):
+        return f"NOT {self.child!r}"
+
+
+@dataclasses.dataclass(frozen=True)
+class In(Expr):
+    child: Expr
+    values: Tuple[Any, ...]
+
+    def __repr__(self):
+        return f"{self.child!r} IN {list(self.values)}"
+
+
+@dataclasses.dataclass(frozen=True)
+class IsNull(Expr):
+    child: Expr
+
+    def __repr__(self):
+        return f"{self.child!r} IS NULL"
+
+
+# ---------------------------------------------------------------------------
+# Tree utilities (planner surface)
+# ---------------------------------------------------------------------------
+
+
+def references(expr: Expr) -> Set[str]:
+    """Column names referenced by the expression
+    (Catalyst ``Expression.references``)."""
+    if isinstance(expr, Col):
+        return {expr.name}
+    if isinstance(expr, Lit):
+        return set()
+    if isinstance(expr, _Binary):
+        return references(expr.left) | references(expr.right)
+    if isinstance(expr, (Not, IsNull)):
+        return references(expr.child)
+    if isinstance(expr, In):
+        return references(expr.child)
+    raise HyperspaceException(f"Unknown expression: {expr!r}")
+
+
+def split_conjuncts(expr: Optional[Expr]) -> List[Expr]:
+    """CNF top level: flatten nested ANDs
+    (``JoinIndexRule`` CNF handling, JoinIndexRule.scala:164-170)."""
+    if expr is None:
+        return []
+    if isinstance(expr, And):
+        return split_conjuncts(expr.left) + split_conjuncts(expr.right)
+    return [expr]
+
+
+def conjunction(exprs: List[Expr]) -> Optional[Expr]:
+    out: Optional[Expr] = None
+    for e in exprs:
+        out = e if out is None else And(out, e)
+    return out
+
+
+def equi_join_pairs(cond: Expr) -> Optional[List[Tuple[str, str]]]:
+    """If cond is a conjunction of Col == Col, the (left, right) name pairs;
+    else None (JoinIndexRule CNF equi-condition check :164-170)."""
+    pairs = []
+    for c in split_conjuncts(cond):
+        if isinstance(c, Eq) and isinstance(c.left, Col) and isinstance(c.right, Col):
+            pairs.append((c.left.name, c.right.name))
+        else:
+            return None
+    return pairs
+
+
+# ---------------------------------------------------------------------------
+# Evaluation (host numpy; the device twin lives in ops/filter.py)
+# ---------------------------------------------------------------------------
+
+
+class _StringRef:
+    """A string column's evaluation view: codes + dictionary rank tables."""
+
+    __slots__ = ("codes", "dictionary", "sorted_dict", "rank")
+
+    def __init__(self, codes: np.ndarray, dictionary: List[str]):
+        self.codes = codes
+        self.dictionary = dictionary
+        order = sorted(range(len(dictionary)), key=lambda i: dictionary[i])
+        self.sorted_dict = [dictionary[i] for i in order]
+        rank = np.empty(max(len(dictionary), 1), dtype=np.int64)
+        for r, i in enumerate(order):
+            rank[i] = r
+        self.rank = rank
+
+    @property
+    def valid(self) -> np.ndarray:
+        return self.codes >= 0
+
+    def code_of(self, value: str) -> int:
+        """Dictionary code of value, or -2 if absent (never matches)."""
+        try:
+            return self.dictionary.index(value)
+        except ValueError:
+            return -2
+
+    def rank_values(self) -> np.ndarray:
+        return self.rank[np.maximum(self.codes, 0)]
+
+    def rank_bounds(self, value: str) -> Tuple[int, int]:
+        """(bisect_left, bisect_right) of value in the sorted dictionary —
+        turns string ordering comparisons into integer rank comparisons."""
+        return (
+            bisect.bisect_left(self.sorted_dict, value),
+            bisect.bisect_right(self.sorted_dict, value),
+        )
+
+
+_Val = Tuple[Any, Optional[np.ndarray]]  # (values-or-_StringRef, valid|None)
+
+
+def _column_ref(batch, name: str) -> _Val:
+    col = batch.column(name)
+    if col.kind == "string":
+        ref = _StringRef(col.codes, col.dictionary)
+        v = ref.valid
+        return ref, None if v.all() else v
+    if col.validity is not None:
+        return col.values, col.validity
+    return col.values, None
+
+
+def _both_valid(a: Optional[np.ndarray], b: Optional[np.ndarray]):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+def _cmp(expr: Expr, batch, op_name: str) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    left, right = expr.left, expr.right
+    # Normalize Lit-on-left
+    flipped = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "!=": "!="}
+    if isinstance(left, Lit) and not isinstance(right, Lit):
+        left, right = right, left
+        op_name = flipped[op_name]
+    if isinstance(left, Col) and isinstance(right, Lit):
+        vref, valid = _column_ref(batch, left.name)
+        lit = right.value
+        if lit is None:
+            n = batch.num_rows
+            return np.zeros(n, bool), np.zeros(n, bool)
+        if isinstance(vref, _StringRef):
+            if op_name in ("=", "!="):
+                code = vref.code_of(str(lit))
+                vals = vref.codes == code
+                if op_name == "!=":
+                    vals = ~vals & vref.valid
+                valid = _both_valid(valid, None)
+                return vals, vref.valid if valid is None else valid
+            lo, hi = vref.rank_bounds(str(lit))
+            r = vref.rank_values()
+            vals = {"<": r < lo, "<=": r < hi, ">": r >= hi, ">=": r >= lo}[op_name]
+            return vals, vref.valid
+        v = vref
+        with np.errstate(invalid="ignore"):
+            vals = {
+                "=": v == lit,
+                "!=": v != lit,
+                "<": v < lit,
+                "<=": v <= lit,
+                ">": v > lit,
+                ">=": v >= lit,
+            }[op_name]
+        return np.asarray(vals, dtype=bool), valid
+    if isinstance(left, Col) and isinstance(right, Col):
+        lv, lvalid = _column_ref(batch, left.name)
+        rv, rvalid = _column_ref(batch, right.name)
+        if isinstance(lv, _StringRef) or isinstance(rv, _StringRef):
+            if not (isinstance(lv, _StringRef) and isinstance(rv, _StringRef)):
+                raise HyperspaceException(
+                    f"Type mismatch comparing {left!r} and {right!r}"
+                )
+            # col-col string compare: remap right codes into left dictionary
+            from hyperspace_tpu.io.columnar import Column as _C
+            from hyperspace_tpu.io.columnar import remap_codes
+
+            rcol = _C("string", None, codes=rv.codes, dictionary=rv.dictionary)
+            rcodes = remap_codes(lv.dictionary, rcol)
+            if op_name == "=":
+                vals = lv.codes == rcodes
+            elif op_name == "!=":
+                vals = lv.codes != rcodes
+            else:
+                raise HyperspaceException(
+                    "Ordering comparison between two string columns is not supported"
+                )
+            return vals, _both_valid(lv.valid, rv.valid)
+        with np.errstate(invalid="ignore"):
+            vals = {
+                "=": lv == rv,
+                "!=": lv != rv,
+                "<": lv < rv,
+                "<=": lv <= rv,
+                ">": lv > rv,
+                ">=": lv >= rv,
+            }[op_name]
+        return np.asarray(vals, dtype=bool), _both_valid(lvalid, rvalid)
+    raise HyperspaceException(f"Unsupported comparison operands: {expr!r}")
+
+
+def evaluate(expr: Expr, batch) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Null-aware evaluation -> (bool values, valid mask|None).
+
+    A row passes a filter iff values & (valid if not None else True).
+    """
+    n = batch.num_rows
+    if isinstance(expr, Lit):
+        if expr.value is None:
+            return np.zeros(n, bool), np.zeros(n, bool)
+        return np.full(n, bool(expr.value)), None
+    if isinstance(expr, (Eq, Ne, Lt, Le, Gt, Ge)):
+        return _cmp(expr, batch, expr.op)
+    if isinstance(expr, And):
+        lv, lk = evaluate(expr.left, batch)
+        rv, rk = evaluate(expr.right, batch)
+        vals = lv & rv
+        if lk is None and rk is None:
+            return vals, None
+        lk = np.ones(n, bool) if lk is None else lk
+        rk = np.ones(n, bool) if rk is None else rk
+        # Kleene: known if both known, or either side is known-false
+        known = (lk & rk) | (lk & ~lv) | (rk & ~rv)
+        return vals & lk & rk, known
+    if isinstance(expr, Or):
+        lv, lk = evaluate(expr.left, batch)
+        rv, rk = evaluate(expr.right, batch)
+        lk = np.ones(n, bool) if lk is None else lk
+        rk = np.ones(n, bool) if rk is None else rk
+        vals = (lv & lk) | (rv & rk)
+        known = (lk & rk) | (lk & lv) | (rk & rv)
+        return vals, known
+    if isinstance(expr, Not):
+        v, k = evaluate(expr.child, batch)
+        return ~v, k
+    if isinstance(expr, IsNull):
+        if isinstance(expr.child, Col):
+            _vref, valid = _column_ref(batch, expr.child.name)
+            if isinstance(_vref, _StringRef):
+                return ~_vref.valid, None
+            if valid is None:
+                return np.zeros(n, bool), None
+            return ~valid, None
+        v, k = evaluate(expr.child, batch)
+        return (np.zeros(n, bool) if k is None else ~k), None
+    if isinstance(expr, In):
+        if not isinstance(expr.child, Col):
+            raise HyperspaceException("IN requires a column operand")
+        vref, valid = _column_ref(batch, expr.child.name)
+        if isinstance(vref, _StringRef):
+            codes = {vref.code_of(str(v)) for v in expr.values if v is not None}
+            codes.discard(-2)
+            vals = np.isin(vref.codes, np.array(sorted(codes), dtype=np.int64))
+            return vals, vref.valid
+        lits = [v for v in expr.values if v is not None]
+        vals = np.isin(vref, np.array(lits))
+        return vals, valid
+    raise HyperspaceException(f"Cannot evaluate expression: {expr!r}")
+
+
+def filter_mask(expr: Expr, batch) -> np.ndarray:
+    vals, valid = evaluate(expr, batch)
+    return vals if valid is None else (vals & valid)

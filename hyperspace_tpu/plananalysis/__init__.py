@@ -1,0 +1,6 @@
+"""Plan analysis & introspection: explain, whyNot, statistics.
+
+Reference: ``index/plananalysis/`` — ``PlanAnalyzer`` (with/without plan
+diff), ``CandidateIndexAnalyzer`` (whyNot reason harvesting),
+``FilterReason`` catalog, ``IndexStatistics`` surface.
+"""

@@ -1,0 +1,17 @@
+"""Query optimizer extension (L5): index-aware plan rewriting.
+
+Reference: ``index/rules/`` + per-index-kind rules. The pipeline
+(``ApplyHyperspace.apply``, rules/ApplyHyperspace.scala:45-66):
+
+1. fetch all ACTIVE index log entries (TTL-cached),
+2. per source Scan, collect *candidates* (schema filter + signature /
+   Hybrid-Scan filter — ``CandidateIndexCollector``),
+3. run the score-based optimizer over the whole plan
+   (``ScoreBasedIndexPlanOptimizer``) trying FilterIndexRule,
+   JoinIndexRule, z-order and data-skipping rules, keeping the max-score
+   rewrite; any exception falls back to the original plan.
+"""
+
+from hyperspace_tpu.rules.apply import apply_hyperspace, hyperspace_rule_disabled
+
+__all__ = ["apply_hyperspace", "hyperspace_rule_disabled"]

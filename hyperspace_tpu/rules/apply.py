@@ -1,0 +1,67 @@
+"""ApplyHyperspace — the optimizer entry point.
+
+Reference: ``rules/ApplyHyperspace.scala:32-76``: gated by config and a
+thread-local maintenance disable (`:43`; index-maintenance scans must not
+be rewritten to read the index being maintained); fetches ACTIVE log
+entries, collects candidates, runs the score-based optimizer; **any
+exception falls back to the original plan** (`:60-64`).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+from typing import Optional
+
+from hyperspace_tpu.constants import States
+from hyperspace_tpu.plan.nodes import LogicalPlan
+from hyperspace_tpu.rules.candidate import collect_candidates
+from hyperspace_tpu.rules.score import ScoreBasedIndexPlanOptimizer
+from hyperspace_tpu.telemetry import HyperspaceIndexUsageEvent
+
+logger = logging.getLogger(__name__)
+
+_local = threading.local()
+
+
+@contextlib.contextmanager
+def hyperspace_rule_disabled():
+    """Thread-local guard (ApplyHyperspace.withHyperspaceRuleDisabled:68-75)."""
+    prev = getattr(_local, "disabled", False)
+    _local.disabled = True
+    try:
+        yield
+    finally:
+        _local.disabled = prev
+
+
+def apply_hyperspace(session, plan: LogicalPlan) -> LogicalPlan:
+    if getattr(_local, "disabled", False):
+        return plan
+    try:
+        entries = session.index_manager.get_indexes([States.ACTIVE])
+        if not entries:
+            return plan
+        candidates = collect_candidates(session, plan, entries)
+        if not candidates:
+            return plan
+        new_plan = ScoreBasedIndexPlanOptimizer(session).apply(plan, candidates)
+        if new_plan is not plan:
+            used = sorted(
+                {
+                    leaf.relation.index_info[0]
+                    for leaf in new_plan.collect_leaves()
+                    if leaf.relation.index_info
+                }
+            )
+            if used:
+                session.event_logging.log_event(
+                    HyperspaceIndexUsageEvent(
+                        index_names=used, plan=new_plan.pretty()
+                    )
+                )
+        return new_plan
+    except Exception:  # fall back to the original plan (:60-64)
+        logger.exception("Hyperspace plan rewrite failed; using original plan")
+        return plan

@@ -1,0 +1,155 @@
+"""Candidate index collection: which ACTIVE indexes could serve each Scan.
+
+Reference: ``rules/CandidateIndexCollector.scala:28-60`` — per source leaf
+relation apply ``ColumnSchemaFilter`` (index's referenced cols ⊆ relation
+cols, rules/ColumnSchemaFilter.scala:28-44) then ``FileSignatureFilter``
+(exact signature equality, or Hybrid Scan candidacy with appended/deleted
+byte-ratio thresholds, rules/FileSignatureFilter.scala:33-192).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+from hyperspace_tpu.metadata.entry import FileInfo, IndexLogEntry
+from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
+from hyperspace_tpu.plananalysis import filter_reasons as FR
+from hyperspace_tpu.rules import tags
+from hyperspace_tpu.rules.base import CandidateMap, tag_filter_reason
+from hyperspace_tpu.utils import resolver
+
+
+def _current_file_infos(session, scan: Scan) -> Dict[str, FileInfo]:
+    """path -> FileInfo for the scan's snapshot, via the source provider SPI
+    so snapshot-based sources (Delta/Iceberg) report their own file view."""
+    rel = session.source_manager.get_relation(scan.relation)
+    return {
+        path: FileInfo(os.path.basename(path), size, mtime, -1)
+        for path, size, mtime in rel.all_file_infos()
+    }
+
+
+def column_schema_filter(
+    scan: Scan, entries: List[IndexLogEntry]
+) -> List[IndexLogEntry]:
+    """Index's referenced columns must all resolve against the relation
+    (ColumnSchemaFilter.scala:28-44)."""
+    out = []
+    cols = scan.relation.column_names
+    for e in entries:
+        refs = e.derived_dataset.referenced_columns()
+        if resolver.resolve(refs, cols) is not None:
+            out.append(e)
+        else:
+            tag_filter_reason(
+                e, scan, FR.col_schema_mismatch(",".join(refs), ",".join(cols))
+            )
+    return out
+
+
+def file_signature_filter(
+    session, scan: Scan, entries: List[IndexLogEntry]
+) -> List[IndexLogEntry]:
+    """Exact-signature mode, or Hybrid Scan candidacy
+    (FileSignatureFilter.scala:49-191)."""
+    hybrid = session.conf.hybrid_scan_enabled
+    out = []
+    for e in entries:
+        if hybrid:
+            ok = _hybrid_scan_candidate(session, scan, e)
+        else:
+            ok = _signature_valid(session, scan, e)
+            if not ok:
+                tag_filter_reason(e, scan, FR.source_data_changed())
+        if ok:
+            out.append(e)
+    return out
+
+
+def _signature_valid(session, scan: Scan, entry: IndexLogEntry) -> bool:
+    """Stored file-based signature == recomputed one
+    (FileSignatureFilter.signatureValid:70-88)."""
+    from hyperspace_tpu.signatures import FileBasedSignatureProvider
+
+    provider = FileBasedSignatureProvider(session.source_manager)
+    current = provider.sign(scan)
+    for sig in entry.fingerprint.signatures:
+        if sig.provider == FileBasedSignatureProvider.name:
+            return sig.value == current
+    return False
+
+
+def _hybrid_scan_candidate(session, scan: Scan, entry: IndexLogEntry) -> bool:
+    """File-level diff against the indexed snapshot; tags the common-bytes
+    and hybrid-required info used by ranking and the rewrite
+    (FileSignatureFilter.getHybridScanCandidate:108-191)."""
+    current = _current_file_infos(session, scan)
+    indexed = entry.source_file_info_set()
+
+    common_paths = []
+    appended = []
+    for path, info in current.items():
+        known = indexed.get(path)
+        if known is not None and known.size == info.size and (
+            known.modified_time == info.modified_time
+        ):
+            common_paths.append(path)
+        else:
+            appended.append((path, info))
+    deleted = [
+        (p, i) for p, i in indexed.items() if p not in current
+        or current[p].size != i.size
+        or current[p].modified_time != i.modified_time
+    ]
+
+    common_bytes = sum(indexed[p].size for p in common_paths)
+    appended_bytes = sum(i.size for _, i in appended)
+    deleted_bytes = sum(i.size for _, i in deleted)
+    total_current = common_bytes + appended_bytes
+    index_source_bytes = common_bytes + deleted_bytes
+
+    if common_bytes == 0:
+        tag_filter_reason(entry, scan, FR.source_data_changed())
+        return False
+    appended_ratio = appended_bytes / total_current if total_current else 0.0
+    deleted_ratio = deleted_bytes / index_source_bytes if index_source_bytes else 0.0
+    max_appended = session.conf.hybrid_scan_max_appended_ratio
+    max_deleted = session.conf.hybrid_scan_max_deleted_ratio
+    if appended_ratio > max_appended:
+        tag_filter_reason(
+            entry, scan, FR.too_much_appended(appended_ratio, max_appended)
+        )
+        return False
+    if deleted:
+        if not entry.derived_dataset.can_handle_deleted_files:
+            tag_filter_reason(entry, scan, FR.no_delete_support())
+            return False
+        if deleted_ratio > max_deleted:
+            tag_filter_reason(
+                entry, scan, FR.too_much_deleted(deleted_ratio, max_deleted)
+            )
+            return False
+
+    entry.set_tag(scan, tags.COMMON_SOURCE_SIZE_IN_BYTES, common_bytes)
+    entry.set_tag(
+        scan, tags.HYBRIDSCAN_REQUIRED, bool(appended or deleted)
+    )
+    entry.set_tag(scan, tags.HYBRIDSCAN_APPENDED, [p for p, _ in appended])
+    # deleted file ids come from the indexed snapshot's lineage ids
+    deleted_ids = [i.id for _, i in deleted if i.id != -1]
+    entry.set_tag(scan, tags.HYBRIDSCAN_DELETED, deleted_ids)
+    return True
+
+
+def collect_candidates(
+    session, plan: LogicalPlan, entries: List[IndexLogEntry]
+) -> CandidateMap:
+    """CandidateIndexCollector.apply:49-59."""
+    out: CandidateMap = {}
+    for scan in plan.collect_leaves():
+        step1 = column_schema_filter(scan, entries)
+        step2 = file_signature_filter(session, scan, step1)
+        if step2:
+            out[scan] = step2
+    return out

@@ -1,0 +1,49 @@
+"""Hybrid Scan: serve from a slightly-stale index + compensation.
+
+Reference: ``covering/CoveringIndexRuleUtils.scala:146-288`` —
+
+* appended source files are scanned raw and unioned with the index scan
+  (the reference's ``BucketUnion`` merge, `:256-287`; bucket alignment of
+  the appended delta happens at execution time in our design since
+  sharding is explicit);
+* rows from deleted source files are excluded via the lineage column:
+  ``Filter(Not(In(_data_file_id, deletedIds)))`` (`:244-253`) — pushed
+  into the scan here (``Relation.excluded_file_ids``, applied by
+  ``execution/executor._exec_scan``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+from hyperspace_tpu.constants import DATA_FILE_NAME_ID
+from hyperspace_tpu.metadata.entry import IndexLogEntry
+from hyperspace_tpu.plan.nodes import Project, Scan, Union
+from hyperspace_tpu.rules import tags
+from hyperspace_tpu.rules.rule_utils import index_scan_relation
+
+
+def transform_plan_to_use_hybrid_scan(
+    session, entry: IndexLogEntry, scan: Scan, use_bucket_spec: bool = False
+):
+    appended: List[str] = entry.get_tag(scan, tags.HYBRIDSCAN_APPENDED) or []
+    deleted_ids: List[int] = entry.get_tag(scan, tags.HYBRIDSCAN_DELETED) or []
+    index_rel = index_scan_relation(
+        session,
+        entry,
+        # bucket-pruning claims break once raw appended rows are unioned in
+        use_bucket_spec=use_bucket_spec and not appended,
+        excluded_file_ids=tuple(deleted_ids) if deleted_ids else None,
+    )
+    index_scan = Scan(index_rel)
+    data_cols = [n for n, _ in index_rel.schema_fields if n != DATA_FILE_NAME_ID]
+    if not appended:
+        return Project(data_cols, index_scan)
+    appended_rel = dataclasses.replace(
+        scan.relation, files=tuple(appended), index_info=None
+    )
+    return Union(
+        Project(data_cols, index_scan),
+        Project(data_cols, Scan(appended_rel)),
+    )

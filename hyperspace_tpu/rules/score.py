@@ -1,0 +1,78 @@
+"""Score-based plan optimizer.
+
+Reference: ``rules/ScoreBasedIndexPlanOptimizer.scala:31-81`` — a
+recursive, memoized search: at every node, either some rule rewrites the
+subtree (its score), or the children are optimized independently (sum of
+child scores); keep the max. The rule set mirrors `:32-33`:
+{FilterIndexRule, JoinIndexRule, ApplyDataSkippingIndex,
+ZOrderFilterIndexRule, NoOpRule}.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from hyperspace_tpu.plan.nodes import LogicalPlan
+from hyperspace_tpu.rules.base import CandidateMap, HyperspaceRule, NoOpRule
+
+
+def _all_rules() -> List[HyperspaceRule]:
+    from hyperspace_tpu.rules.filter_rule import FilterIndexRule
+
+    rules: List[HyperspaceRule] = [FilterIndexRule()]
+    try:
+        from hyperspace_tpu.rules.join_rule import JoinIndexRule
+
+        rules.append(JoinIndexRule())
+    except ImportError:
+        pass
+    try:
+        from hyperspace_tpu.rules.zorder_rule import ZOrderFilterIndexRule
+
+        rules.append(ZOrderFilterIndexRule())
+    except ImportError:
+        pass
+    try:
+        from hyperspace_tpu.rules.dataskipping_rule import ApplyDataSkippingIndex
+
+        rules.append(ApplyDataSkippingIndex())
+    except ImportError:
+        pass
+    rules.append(NoOpRule())
+    return rules
+
+
+class ScoreBasedIndexPlanOptimizer:
+    def __init__(self, session):
+        self.session = session
+        self.rules = _all_rules()
+
+    def apply(self, plan: LogicalPlan, candidates: CandidateMap) -> LogicalPlan:
+        self._memo: Dict[int, Tuple[LogicalPlan, int]] = {}
+        best, _score = self._rec_apply(plan, candidates)
+        return best
+
+    def _rec_apply(
+        self, plan: LogicalPlan, candidates: CandidateMap
+    ) -> Tuple[LogicalPlan, int]:
+        key = id(plan)
+        if key in self._memo:
+            return self._memo[key]
+        # Option A: optimize children independently
+        best_plan, best_score = plan, 0
+        if plan.children:
+            new_children = []
+            child_score = 0
+            for c in plan.children:
+                p, s = self._rec_apply(c, candidates)
+                new_children.append(p)
+                child_score += s
+            if child_score > 0:
+                best_plan, best_score = plan.with_children(new_children), child_score
+        # Option B: a rule rewrites this subtree wholesale
+        for rule in self.rules:
+            p, s = rule.apply(self.session, plan, candidates)
+            if s > best_score:
+                best_plan, best_score = p, s
+        self._memo[key] = (best_plan, best_score)
+        return best_plan, best_score

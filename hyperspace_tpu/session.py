@@ -1,0 +1,127 @@
+"""HyperspaceSession — conf + mesh + reader + optimizer hook.
+
+The analogue of a SparkSession *for this framework's scope*: it owns the
+config (reference: Spark SQL conf, ``util/HyperspaceConf.scala``), the
+device-mesh runtime (reference: the Spark cluster), source reading
+(reference: ``DataFrameReader``), and the optimizer extension point where
+``enable_hyperspace()`` injects the index-rewrite rule — mirroring the
+implicit ``spark.enableHyperspace()`` (``package.scala:26-95``) and the
+session extension (``HyperspaceSparkSessionExtension.scala:44-69``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, Optional, Sequence
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from hyperspace_tpu.config import Config
+from hyperspace_tpu.dataframe import DataFrame
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.parallel.mesh import MeshRuntime
+from hyperspace_tpu.plan.nodes import Relation, Scan
+from hyperspace_tpu.telemetry import EventLogging
+
+
+class DataFrameReader:
+    """``session.read.parquet(path)`` etc. — builds a Scan over a file
+    snapshot (listing happens here, like Spark's ``InMemoryFileIndex``)."""
+
+    def __init__(self, session: "HyperspaceSession"):
+        self._session = session
+
+    def _scan(self, fmt: str, paths: Sequence[str]) -> DataFrame:
+        from hyperspace_tpu.io.parquet import list_format_files, read_table
+
+        files: List[str] = []
+        for p in paths:
+            if os.path.isfile(p):
+                files.append(p)
+            else:
+                files.extend(list_format_files(p, fmt))
+        if not files:
+            raise HyperspaceException(f"No {fmt} files under {list(paths)}")
+        if fmt == "parquet":
+            schema = pq.read_schema(files[0])
+            fields = tuple((f.name, f.type) for f in schema)
+        else:
+            head = read_table(files[:1], None, fmt)
+            fields = tuple((n, head.schema.field(n).type) for n in head.column_names)
+        rel = Relation(
+            root_paths=tuple(os.path.abspath(p) for p in paths),
+            files=tuple(os.path.abspath(f) for f in files),
+            fmt=fmt,
+            schema_fields=fields,
+        )
+        return DataFrame(self._session, Scan(rel))
+
+    def parquet(self, *paths: str) -> DataFrame:
+        return self._scan("parquet", paths)
+
+    def csv(self, *paths: str) -> DataFrame:
+        return self._scan("csv", paths)
+
+    def json(self, *paths: str) -> DataFrame:
+        return self._scan("json", paths)
+
+
+class HyperspaceSession:
+    def __init__(self, devices: Optional[Sequence] = None):
+        self.conf = Config()
+        self.runtime = MeshRuntime(devices)
+        self.event_logging = EventLogging(self.conf)
+        self._hyperspace_enabled = False
+        self._source_manager = None
+        self._index_manager = None
+
+    # -- context (HyperspaceContext, Hyperspace.scala:195-223) --------------
+    @property
+    def source_manager(self):
+        if self._source_manager is None:
+            from hyperspace_tpu.sources.manager import SourceProviderManager
+
+            self._source_manager = SourceProviderManager(self)
+        return self._source_manager
+
+    @property
+    def index_manager(self):
+        if self._index_manager is None:
+            from hyperspace_tpu.manager import CachingIndexCollectionManager
+
+            self._index_manager = CachingIndexCollectionManager(self)
+        return self._index_manager
+
+    # -- reading ------------------------------------------------------------
+    @property
+    def read(self) -> DataFrameReader:
+        return DataFrameReader(self)
+
+    # -- hyperspace enable/disable (package.scala:40-80) --------------------
+    def enable_hyperspace(self) -> "HyperspaceSession":
+        self._hyperspace_enabled = True
+        return self
+
+    def disable_hyperspace(self) -> "HyperspaceSession":
+        self._hyperspace_enabled = False
+        return self
+
+    def is_hyperspace_enabled(self) -> bool:
+        return self._hyperspace_enabled
+
+    # -- planning & execution ----------------------------------------------
+    def optimize(self, plan):
+        """Apply the Hyperspace rewrite when enabled (the injected-rule
+        equivalent of ``ApplyHyperspace``, rules/ApplyHyperspace.scala:45-66)."""
+        if self._hyperspace_enabled and self.conf.apply_enabled:
+            from hyperspace_tpu.rules.apply import apply_hyperspace
+
+            return apply_hyperspace(self, plan)
+        return plan
+
+    def execute(self, plan) -> pa.Table:
+        from hyperspace_tpu.execution import execute
+
+        return execute(self.optimize(plan), self)

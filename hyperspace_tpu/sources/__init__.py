@@ -1,0 +1,20 @@
+"""Source providers (L4): pluggable adapters from scan relations to
+indexable metadata.
+
+Reference: ``index/sources/`` — the SPI (``interfaces.scala:43-277``), the
+manager that loads builders from config and requires exactly one provider
+to answer (``FileBasedSourceProviderManager.scala:38-174``), and the three
+built-ins: default file-based (parquet/csv/json dirs), Delta Lake, Iceberg.
+"""
+
+from hyperspace_tpu.sources.interfaces import (
+    FileBasedRelation,
+    FileBasedSourceProvider,
+)
+from hyperspace_tpu.sources.manager import SourceProviderManager
+
+__all__ = [
+    "FileBasedRelation",
+    "FileBasedSourceProvider",
+    "SourceProviderManager",
+]

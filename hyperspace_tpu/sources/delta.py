@@ -1,0 +1,32 @@
+"""Delta Lake source provider (full implementation arrives with the Delta
+log reader; see package docstring).
+
+Reference: ``sources/delta/DeltaLakeFileBasedSource.scala``,
+``DeltaLakeRelation.scala:34-252`` (signature = table version + path,
+closest-index time travel), ``DeltaLakeRelationMetadata.scala:25-71``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from hyperspace_tpu.plan.nodes import Relation as PlanRelation
+from hyperspace_tpu.sources.interfaces import FileBasedSourceProvider
+
+
+class DeltaLakeSource(FileBasedSourceProvider):
+    name = "delta"
+
+    def is_supported(self, session, plan_relation: PlanRelation) -> Optional[bool]:
+        if plan_relation.fmt == "delta":
+            return True
+        return None
+
+    def get_relation(self, session, plan_relation: PlanRelation):
+        from hyperspace_tpu.sources.delta_relation import DeltaLakeRelation
+
+        return DeltaLakeRelation(session, plan_relation)
+
+
+def DeltaLakeSourceBuilder():  # noqa: N802
+    return DeltaLakeSource()
