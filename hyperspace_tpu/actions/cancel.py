@@ -1,0 +1,62 @@
+"""CancelAction — roll an interrupted operation back to the last stable
+state.
+
+Reference: ``actions/CancelAction.scala`` (validates the index is stuck in
+a transient state, then appends a copy of the last stable entry so every
+operation sees the pre-failure state again; ``Hyperspace.scala:139-151``).
+Does not follow the begin/op/end protocol — it writes exactly one log
+entry — so it overrides ``run``.
+"""
+
+from __future__ import annotations
+
+from hyperspace_tpu.actions.base import Action
+from hyperspace_tpu.constants import States
+from hyperspace_tpu.exceptions import ConcurrentWriteException, HyperspaceException
+from hyperspace_tpu.metadata.entry import IndexLogEntry
+from hyperspace_tpu.telemetry import CancelActionEvent
+
+
+class CancelAction(Action):
+    transient_state = ""  # unused; run() is overridden
+    final_state = ""
+
+    def __init__(self, session, index_name: str, log_manager):
+        super().__init__(session, log_manager)
+        self.index_name = index_name
+
+    def validate(self) -> None:
+        latest = self.log_manager.get_latest_log()
+        if latest is None:
+            raise HyperspaceException(f"Index not found: {self.index_name!r}")
+        if latest.state in States.STABLE_STATES:
+            raise HyperspaceException(
+                f"Cancel is only supported for transient states; index "
+                f"{self.index_name!r} is {latest.state}"
+            )
+
+    def op(self) -> None:  # pragma: no cover - not used
+        pass
+
+    def log_entry(self) -> IndexLogEntry:  # pragma: no cover - not used
+        raise NotImplementedError
+
+    def run(self) -> None:
+        self.validate()
+        stable = self.log_manager.get_latest_stable_log()
+        if stable is None:
+            # Nothing stable ever existed (failed create): mark DOESNOTEXIST
+            latest = self.log_manager.get_latest_log()
+            entry = latest.with_state(States.DOESNOTEXIST)
+        else:
+            entry = stable.copy()
+        entry.id = self.base_id + 1
+        if not self.log_manager.write_log(self.base_id + 1, entry):
+            raise ConcurrentWriteException(
+                f"Concurrent write at log id {self.base_id + 1}"
+            )
+        self.log_manager.create_latest_stable_log(self.base_id + 1)
+        self._log_event(True)
+
+    def event(self, success, message=""):
+        return CancelActionEvent(index_name=self.index_name, message=message)

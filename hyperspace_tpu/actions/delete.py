@@ -1,0 +1,63 @@
+"""Delete (soft) and Restore actions.
+
+Reference: ``actions/DeleteAction.scala`` (ACTIVE → DELETING → DELETED; no
+data touched — queries just stop seeing the index) and
+``actions/RestoreAction.scala`` (DELETED → RESTORING → ACTIVE).
+"""
+
+from __future__ import annotations
+
+from hyperspace_tpu.actions.base import Action
+from hyperspace_tpu.constants import States
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.metadata.entry import IndexLogEntry
+from hyperspace_tpu.telemetry import DeleteActionEvent, RestoreActionEvent
+
+
+class _StateFlipAction(Action):
+    """Shared shape: require a stable state, rewrite the same entry with a
+    new state; op() touches no data."""
+
+    required_state = ""
+
+    def __init__(self, session, index_name: str, log_manager):
+        super().__init__(session, log_manager)
+        self.index_name = index_name
+        # Validate against the LATEST entry, stable or not: a dangling
+        # transient state (failed action) blocks every operation until
+        # cancel() (reference Action validations read the latest entry;
+        # SURVEY §5 failure-detection notes).
+        self._previous: IndexLogEntry | None = log_manager.get_latest_log()
+
+    def validate(self) -> None:
+        if self._previous is None:
+            raise HyperspaceException(f"Index not found: {self.index_name!r}")
+        if self._previous.state != self.required_state:
+            raise HyperspaceException(
+                f"{type(self).__name__} requires state {self.required_state}; "
+                f"index {self.index_name!r} is {self._previous.state}"
+            )
+
+    def op(self) -> None:
+        pass
+
+    def log_entry(self) -> IndexLogEntry:
+        return self._previous.copy()
+
+
+class DeleteAction(_StateFlipAction):
+    transient_state = States.DELETING
+    final_state = States.DELETED
+    required_state = States.ACTIVE
+
+    def event(self, success, message=""):
+        return DeleteActionEvent(index_name=self.index_name, message=message)
+
+
+class RestoreAction(_StateFlipAction):
+    transient_state = States.RESTORING
+    final_state = States.ACTIVE
+    required_state = States.DELETED
+
+    def event(self, success, message=""):
+        return RestoreActionEvent(index_name=self.index_name, message=message)

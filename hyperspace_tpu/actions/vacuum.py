@@ -1,0 +1,101 @@
+"""Vacuum actions: hard-delete a DELETED index, or garbage-collect outdated
+versions of an ACTIVE one.
+
+Reference: ``actions/VacuumAction.scala`` (DELETED → VACUUMING →
+DOESNOTEXIST: delete all index files; a later create may reuse the name)
+and ``actions/VacuumOutdatedAction.scala:34-144`` (ACTIVE →
+VACUUMINGOUTDATED → ACTIVE: delete every non-latest ``v__=N`` dir and any
+file in retained dirs that the live content no longer references; resets
+the Delta version-history property `:56-67`).
+"""
+
+from __future__ import annotations
+
+import os
+
+from hyperspace_tpu.actions.delete import _StateFlipAction
+from hyperspace_tpu.constants import (
+    DELTA_VERSION_HISTORY_PROPERTY,
+    States,
+)
+from hyperspace_tpu.metadata.entry import IndexLogEntry
+from hyperspace_tpu.telemetry import VacuumActionEvent, VacuumOutdatedActionEvent
+from hyperspace_tpu.utils import files as file_utils
+
+
+class VacuumAction(_StateFlipAction):
+    transient_state = States.VACUUMING
+    final_state = States.DOESNOTEXIST
+    required_state = States.DELETED
+
+    def op(self) -> None:
+        # delete all index data (every version dir referenced or not)
+        index_path = self.log_manager.index_path
+        from hyperspace_tpu.constants import HYPERSPACE_LOG_DIR
+
+        for name in sorted(os.listdir(index_path)):
+            if name == HYPERSPACE_LOG_DIR:
+                continue
+            file_utils.delete(os.path.join(index_path, name))
+
+    def log_entry(self) -> IndexLogEntry:
+        entry = self._previous.copy()
+        from hyperspace_tpu.metadata.entry import Content
+
+        entry.content = Content.from_leaf_files([])
+        return entry
+
+    def event(self, success, message=""):
+        return VacuumActionEvent(index_name=self.index_name, message=message)
+
+
+class VacuumOutdatedAction(_StateFlipAction):
+    transient_state = States.VACUUMINGOUTDATED
+    final_state = States.ACTIVE
+    required_state = States.ACTIVE
+
+    def __init__(self, session, index_name, log_manager, data_manager):
+        super().__init__(session, index_name, log_manager)
+        self.data_manager = data_manager
+
+    def op(self) -> None:
+        """Delete non-latest version dirs + unreferenced files in retained
+        dirs (VacuumOutdatedAction.op:86-120)."""
+        live_files = set(self._previous.content.files)
+        live_versions = {
+            v
+            for v in (
+                self._version_of(f) for f in live_files
+            )
+            if v is not None
+        }
+        for version in self.data_manager.get_all_versions():
+            if version not in live_versions:
+                self.data_manager.delete(version)
+                continue
+            root = self.data_manager.get_path(version)
+            for path, _s, _m in file_utils.list_leaf_files(root):
+                if path not in live_files:
+                    file_utils.delete(path)
+
+    @staticmethod
+    def _version_of(path: str):
+        from hyperspace_tpu.metadata.data_manager import version_from_path
+
+        return version_from_path(path)
+
+    def log_entry(self) -> IndexLogEntry:
+        entry = self._previous.copy()
+        # reset provider version-history bookkeeping: only the surviving
+        # index version remains addressable (Delta reset :56-67)
+        index = entry.derived_dataset
+        if DELTA_VERSION_HISTORY_PROPERTY in index.properties:
+            history = index.properties[DELTA_VERSION_HISTORY_PROPERTY]
+            last = history.split(",")[-1] if history else ""
+            index.properties[DELTA_VERSION_HISTORY_PROPERTY] = last
+        return entry
+
+    def event(self, success, message=""):
+        return VacuumOutdatedActionEvent(
+            index_name=self.index_name, message=message
+        )

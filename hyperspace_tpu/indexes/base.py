@@ -67,7 +67,9 @@ class Index(abc.ABC):
             f"{self.kind} does not support incremental refresh"
         )
 
-    def refresh_full(self, ctx, df) -> "Tuple[Index, object]":
+    def refresh_full(self, ctx, df) -> "Index":
+        """Rebuild from the current source; returns the rebuilt Index (its
+        schema may differ if source types changed)."""
         raise NotImplementedError(f"{self.kind} does not support full refresh")
 
     @property

@@ -142,6 +142,13 @@ class CoveringIndex(Index):
             previous_content,
         )
 
+    def refresh_full(self, ctx, df) -> "CoveringIndex":
+        """Full rebuild from the current source state
+        (CoveringIndexTrait.refreshFull:108-126)."""
+        from hyperspace_tpu.indexes import covering_build
+
+        return covering_build.refresh_full(ctx, self, df)
+
     def statistics(self, extended: bool = False) -> Dict[str, str]:
         return {
             "indexedColumns": ",".join(self._indexed_columns),

@@ -287,6 +287,18 @@ def refresh_incremental(
     return index, mode
 
 
+def refresh_full(ctx, index, df):
+    """Rebuild the whole index from the current source
+    (CoveringIndexTrait.refreshFull:108-126). Returns the REBUILT index —
+    its schema_json reflects the current source types, which may have
+    changed since the original build."""
+    new_index, batch = create_covering_index(
+        ctx, df, _config_of(index), dict(index.properties)
+    )
+    write_bucketed(ctx, batch, new_index.indexed_columns, new_index.num_buckets)
+    return new_index
+
+
 def _config_of(index):
     from hyperspace_tpu.indexes.covering import CoveringIndexConfig
 

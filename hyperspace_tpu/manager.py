@@ -56,7 +56,7 @@ class IndexCollectionManager:
         from hyperspace_tpu.actions.vacuum import VacuumAction, VacuumOutdatedAction
 
         log_mgr, data_mgr = self._managers(index_name)
-        entry = log_mgr.get_latest_stable_log()
+        entry = log_mgr.get_latest_log()
         if entry is None:
             raise HyperspaceException(f"Index not found: {index_name!r}")
         if entry.state == States.DELETED:

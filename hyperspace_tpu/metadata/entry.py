@@ -550,6 +550,17 @@ class IndexLogEntry(LogEntry):
                     files.pop(p, None)
         return files
 
+    @property
+    def has_source_update(self) -> bool:
+        """True when a quick refresh recorded a pending source delta
+        (IndexLogEntry.hasSourceUpdate): the fingerprint matches the newer
+        source but the index DATA still reflects the original snapshot, so
+        serving requires Hybrid Scan compensation."""
+        u = self.relation.update
+        return u is not None and (
+            u.appended_files is not None or u.deleted_files is not None
+        )
+
     def file_id_tracker(self) -> FileIdTracker:
         """Rebuild the tracker from recorded source + index file ids."""
         t = FileIdTracker()

@@ -60,6 +60,13 @@ def file_signature_filter(
             ok = _hybrid_scan_candidate(session, scan, e)
         else:
             ok = _signature_valid(session, scan, e)
+            if ok and e.has_source_update:
+                # Quick-refreshed entry: fingerprint matches the new source
+                # but the DATA covers only the original snapshot — accept
+                # and compensate at rewrite time from the recorded Update
+                # delta (the reference's exact-mode quick-refresh path,
+                # CoveringIndexRuleUtils.scala:74-79,164-170).
+                _tag_update_compensation(scan, e)
             if not ok:
                 tag_filter_reason(e, scan, FR.source_data_changed())
         if ok:
@@ -80,12 +87,36 @@ def _signature_valid(session, scan: Scan, entry: IndexLogEntry) -> bool:
     return False
 
 
+def _tag_update_compensation(scan: Scan, entry: IndexLogEntry) -> None:
+    """Set the Hybrid-Scan compensation tags from a quick refresh's recorded
+    Update delta (no file diffing needed — the delta is in the metadata)."""
+    upd = entry.relation.update
+    appended = (
+        [p for p, _ in upd.appended_files.file_infos] if upd.appended_files else []
+    )
+    deleted_ids = (
+        [i.id for _, i in upd.deleted_files.file_infos if i.id != -1]
+        if upd.deleted_files
+        else []
+    )
+    entry.set_tag(
+        scan, tags.COMMON_SOURCE_SIZE_IN_BYTES, entry.relation.content.size_in_bytes
+    )
+    entry.set_tag(scan, tags.HYBRIDSCAN_REQUIRED, True)
+    entry.set_tag(scan, tags.HYBRIDSCAN_APPENDED, appended)
+    entry.set_tag(scan, tags.HYBRIDSCAN_DELETED, deleted_ids)
+
+
 def _hybrid_scan_candidate(session, scan: Scan, entry: IndexLogEntry) -> bool:
     """File-level diff against the indexed snapshot; tags the common-bytes
     and hybrid-required info used by ranking and the rewrite
     (FileSignatureFilter.getHybridScanCandidate:108-191)."""
     current = _current_file_infos(session, scan)
-    indexed = entry.source_file_info_set()
+    # Diff against what the index DATA covers (the build-time snapshot,
+    # relation.content) — NOT the update-adjusted metadata view: a quick
+    # refresh moves the metadata forward while the data stays put, and the
+    # compensation must cover exactly that gap.
+    indexed = dict(entry.relation.content.file_infos)
 
     common_paths = []
     appended = []

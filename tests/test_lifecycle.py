@@ -1,0 +1,318 @@
+"""Lifecycle action tests: delete/restore/vacuum/cancel/refresh/optimize.
+
+Mirrors the reference's per-action suites (``actions/*ActionTest.scala``)
+plus refresh E2E scenarios (append/delete matrices of
+``RefreshIndexTest``/``HybridScanSuite``).
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.constants import States
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+
+
+@pytest.fixture
+def hs(session):
+    return Hyperspace(session)
+
+
+def sorted_table(t):
+    return t.sort_by([(c, "ascending") for c in t.column_names])
+
+
+def state_of(session, name):
+    return session.index_manager.get_index_log_entry(name).state
+
+
+def append_file(sample_parquet, name="extra", clicks=(9001, 9002, 9003)):
+    t = pa.table(
+        {
+            "date": ["2018-02-02"] * len(clicks),
+            "rguid": [f"g{i}" for i in range(len(clicks))],
+            "clicks": pa.array(list(clicks), pa.int64()),
+            "query": ["appended"] * len(clicks),
+            "imprs": pa.array(list(range(len(clicks))), pa.int64()),
+        }
+    )
+    pq.write_table(t, os.path.join(sample_parquet, f"part-{name}.parquet"))
+
+
+class TestDeleteRestoreVacuum:
+    def test_delete_restore_roundtrip(self, session, hs, sample_parquet):
+        df = session.read.parquet(sample_parquet)
+        hs.create_index(df, CoveringIndexConfig("idx", ["clicks"], ["query"]))
+        hs.delete_index("idx")
+        assert state_of(session, "idx") == States.DELETED
+        # deleted index is not used
+        session.enable_hyperspace()
+        session.index_manager.clear_cache()
+        plan = df.filter(df["clicks"] > 1).select("clicks", "query").explain()
+        assert "Hyperspace" not in plan
+        hs.restore_index("idx")
+        assert state_of(session, "idx") == States.ACTIVE
+        session.index_manager.clear_cache()
+        plan = df.filter(df["clicks"] > 1).select("clicks", "query").explain()
+        assert "Hyperspace" in plan
+
+    def test_delete_requires_active(self, session, hs, sample_parquet):
+        df = session.read.parquet(sample_parquet)
+        hs.create_index(df, CoveringIndexConfig("idx", ["clicks"]))
+        hs.delete_index("idx")
+        with pytest.raises(HyperspaceException, match="requires state ACTIVE"):
+            hs.delete_index("idx")
+
+    def test_vacuum_deleted_removes_everything(
+        self, session, hs, sample_parquet, tmp_index_root
+    ):
+        df = session.read.parquet(sample_parquet)
+        hs.create_index(df, CoveringIndexConfig("idx", ["clicks"]))
+        hs.delete_index("idx")
+        hs.vacuum_index("idx")
+        assert state_of(session, "idx") == States.DOESNOTEXIST
+        idx_dir = os.path.join(tmp_index_root, "idx")
+        leftover = [
+            d for d in os.listdir(idx_dir) if d != C.HYPERSPACE_LOG_DIR
+        ]
+        assert leftover == []
+        # name reusable after vacuum
+        hs.create_index(df, CoveringIndexConfig("idx", ["clicks"]))
+        assert state_of(session, "idx") == States.ACTIVE
+
+    def test_vacuum_outdated_keeps_only_live_versions(
+        self, session, hs, sample_parquet, tmp_index_root
+    ):
+        df = session.read.parquet(sample_parquet)
+        hs.create_index(df, CoveringIndexConfig("idx", ["clicks"], ["query"]))
+        append_file(sample_parquet)
+        hs.refresh_index("idx", "full")  # new version dir v__=2
+        hs.vacuum_index("idx")  # ACTIVE -> vacuum outdated
+        assert state_of(session, "idx") == States.ACTIVE
+        idx_dir = os.path.join(tmp_index_root, "idx")
+        versions = [d for d in os.listdir(idx_dir) if d.startswith("v__=")]
+        assert versions == ["v__=2"]
+        # still serves correctly
+        session.enable_hyperspace()
+        session.index_manager.clear_cache()
+        df2 = session.read.parquet(sample_parquet)
+        q = lambda d: d.filter(d["clicks"] >= 9000).select("clicks", "query")
+        assert "Hyperspace" in q(df2).explain()
+        assert q(df2).count() == 3
+
+
+class TestCancel:
+    def test_cancel_rolls_back_transient_state(
+        self, session, hs, sample_parquet, monkeypatch
+    ):
+        df = session.read.parquet(sample_parquet)
+        hs.create_index(df, CoveringIndexConfig("idx", ["clicks"], ["query"]))
+
+        # Make refresh fail mid-op, leaving REFRESHING in the log
+        from hyperspace_tpu.actions import refresh as refresh_mod
+
+        def boom(self):
+            raise RuntimeError("simulated op failure")
+
+        append_file(sample_parquet)
+        monkeypatch.setattr(refresh_mod.RefreshAction, "op", boom)
+        with pytest.raises(RuntimeError):
+            hs.refresh_index("idx", "full")
+        log_mgr, _ = session.index_manager._managers("idx")
+        assert log_mgr.get_latest_log().state == States.REFRESHING
+        # all operations blocked until cancel
+        monkeypatch.undo()
+        with pytest.raises(HyperspaceException):
+            hs.delete_index("idx")  # stable log says ACTIVE but ids advanced
+        hs.cancel("idx")
+        assert log_mgr.get_latest_log().state == States.ACTIVE
+        hs.delete_index("idx")  # now works
+        assert state_of(session, "idx") == States.DELETED
+
+    def test_cancel_requires_transient(self, session, hs, sample_parquet):
+        df = session.read.parquet(sample_parquet)
+        hs.create_index(df, CoveringIndexConfig("idx", ["clicks"]))
+        with pytest.raises(HyperspaceException, match="transient"):
+            hs.cancel("idx")
+
+
+class TestRefresh:
+    def _mk(self, session, hs, sample_parquet, lineage=False):
+        if lineage:
+            session.conf.set(C.INDEX_LINEAGE_ENABLED, True)
+        df = session.read.parquet(sample_parquet)
+        hs.create_index(df, CoveringIndexConfig("idx", ["clicks"], ["query"]))
+        return df
+
+    def test_refresh_full_after_append(self, session, hs, sample_parquet):
+        self._mk(session, hs, sample_parquet)
+        append_file(sample_parquet)
+        hs.refresh_index("idx", "full")
+        session.enable_hyperspace()
+        session.index_manager.clear_cache()
+        df2 = session.read.parquet(sample_parquet)
+        q = lambda d: d.filter(d["clicks"] >= 9000).select("clicks", "query")
+        plan = q(df2).explain()
+        assert "Hyperspace(Type: CI, Name: idx" in plan
+        session.disable_hyperspace()
+        base = q(df2).collect()
+        session.enable_hyperspace()
+        assert sorted_table(q(df2).collect()).equals(sorted_table(base))
+        assert q(df2).count() == 3
+
+    def test_refresh_noop_when_unchanged(self, session, hs, sample_parquet):
+        self._mk(session, hs, sample_parquet)
+        log_mgr, _ = session.index_manager._managers("idx")
+        before = log_mgr.get_latest_id()
+        hs.refresh_index("idx", "full")  # NoChangesException swallowed
+        assert log_mgr.get_latest_id() == before
+
+    def test_refresh_incremental_append_only(self, session, hs, sample_parquet):
+        self._mk(session, hs, sample_parquet)
+        append_file(sample_parquet)
+        hs.refresh_index("idx", "incremental")
+        entry = session.index_manager.get_index_log_entry("idx")
+        # merged content spans two version dirs
+        versions = {f.split("v__=")[1].split("/")[0] for f in entry.content.files}
+        assert versions == {"1", "2"}
+        session.enable_hyperspace()
+        session.index_manager.clear_cache()
+        df2 = session.read.parquet(sample_parquet)
+        q = lambda d: d.filter(d["clicks"] >= 500).select("clicks", "query")
+        plan = q(df2).explain()
+        assert "Hyperspace" in plan
+        session.disable_hyperspace()
+        base = q(df2).collect()
+        session.enable_hyperspace()
+        assert sorted_table(q(df2).collect()).equals(sorted_table(base))
+
+    def test_refresh_incremental_delete_requires_lineage(
+        self, session, hs, sample_parquet
+    ):
+        self._mk(session, hs, sample_parquet, lineage=False)
+        os.remove(os.path.join(sample_parquet, "part-0.parquet"))
+        with pytest.raises(HyperspaceException, match="lineage"):
+            hs.refresh_index("idx", "incremental")
+
+    def test_refresh_incremental_with_deletes(self, session, hs, sample_parquet):
+        self._mk(session, hs, sample_parquet, lineage=True)
+        os.remove(os.path.join(sample_parquet, "part-0.parquet"))
+        append_file(sample_parquet)
+        hs.refresh_index("idx", "incremental")
+        session.enable_hyperspace()
+        session.index_manager.clear_cache()
+        df2 = session.read.parquet(sample_parquet)
+        q = lambda d: d.filter(d["clicks"] >= 0).select("clicks", "query")
+        plan = q(df2).explain()
+        assert "Hyperspace" in plan
+        session.disable_hyperspace()
+        base = q(df2).collect()
+        session.enable_hyperspace()
+        got = q(df2).collect()
+        assert sorted_table(got).equals(sorted_table(base))
+        assert got.num_rows == 203  # 300 - 100 deleted + 3 appended
+
+    def test_refresh_quick_then_hybrid_serve(self, session, hs, sample_parquet):
+        self._mk(session, hs, sample_parquet, lineage=True)
+        append_file(sample_parquet)
+        hs.refresh_index("idx", "quick")
+        entry = session.index_manager.get_index_log_entry("idx")
+        assert entry.relation.update is not None
+        assert entry.relation.update.appended_files is not None
+        # quick refresh + hybrid scan serves fresh data from old index files
+        session.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, True)
+        session.enable_hyperspace()
+        session.index_manager.clear_cache()
+        df2 = session.read.parquet(sample_parquet)
+        q = lambda d: d.filter(d["clicks"] >= 500).select("clicks", "query")
+        plan = q(df2).explain()
+        assert "Hyperspace" in plan
+        session.disable_hyperspace()
+        base = q(df2).collect()
+        session.enable_hyperspace()
+        assert sorted_table(q(df2).collect()).equals(sorted_table(base))
+
+
+    def test_quick_then_incremental_materializes_pending_files(
+        self, session, hs, sample_parquet
+    ):
+        """Files recorded by a quick refresh were never indexed; a later
+        incremental refresh must still materialize them."""
+        self._mk(session, hs, sample_parquet, lineage=True)
+        append_file(sample_parquet)
+        hs.refresh_index("idx", "quick")
+        hs.refresh_index("idx", "incremental")  # must NOT be a no-op
+        entry = session.index_manager.get_index_log_entry("idx")
+        assert not entry.has_source_update
+        session.enable_hyperspace()
+        session.index_manager.clear_cache()
+        df2 = session.read.parquet(sample_parquet)
+        q = lambda d: d.filter(d["clicks"] >= 9000).select("clicks", "query")
+        plan = q(df2).explain()
+        assert "Hyperspace" in plan and "Union" not in plan
+        assert q(df2).count() == 3  # appended rows served from index data
+
+    def test_refresh_quick_serves_in_exact_mode(
+        self, session, hs, sample_parquet
+    ):
+        """Quick refresh must keep the index usable WITHOUT hybrid scan:
+        the rewrite compensates from the recorded Update delta."""
+        self._mk(session, hs, sample_parquet, lineage=True)
+        append_file(sample_parquet)
+        hs.refresh_index("idx", "quick")
+        session.enable_hyperspace()  # hybrid scan stays DISABLED
+        session.index_manager.clear_cache()
+        df2 = session.read.parquet(sample_parquet)
+        q = lambda d: d.filter(d["clicks"] >= 500).select("clicks", "query")
+        plan = q(df2).explain()
+        assert "Hyperspace" in plan and "Union" in plan
+        session.disable_hyperspace()
+        base = q(df2).collect()
+        session.enable_hyperspace()
+        got = q(df2).collect()
+        assert sorted_table(got).equals(sorted_table(base))
+        assert "appended" in got.column("query").to_pylist()
+
+
+class TestOptimize:
+    def test_optimize_compacts_buckets(self, session, hs, sample_parquet):
+        df = session.read.parquet(sample_parquet)
+        hs.create_index(df, CoveringIndexConfig("idx", ["clicks"], ["query"]))
+        append_file(sample_parquet, "e1")
+        hs.refresh_index("idx", "incremental")
+        append_file(sample_parquet, "e2", clicks=(9101, 9102))
+        hs.refresh_index("idx", "incremental")
+        entry = session.index_manager.get_index_log_entry("idx")
+        files_before = len(entry.content.files)
+        hs.optimize_index("idx", "full")
+        entry2 = session.index_manager.get_index_log_entry("idx")
+        assert len(entry2.content.files) < files_before
+        # results still correct
+        session.enable_hyperspace()
+        session.index_manager.clear_cache()
+        df2 = session.read.parquet(sample_parquet)
+        q = lambda d: d.filter(d["clicks"] >= 500).select("clicks", "query")
+        session.disable_hyperspace()
+        base = q(df2).collect()
+        session.enable_hyperspace()
+        assert sorted_table(q(df2).collect()).equals(sorted_table(base))
+
+    def test_optimize_noop_single_files(self, session, hs, sample_parquet):
+        df = session.read.parquet(sample_parquet)
+        hs.create_index(df, CoveringIndexConfig("idx", ["clicks"]))
+        log_mgr, _ = session.index_manager._managers("idx")
+        before = log_mgr.get_latest_id()
+        hs.optimize_index("idx", "full")  # every bucket has 1 file -> no-op
+        assert log_mgr.get_latest_id() == before
+
+    def test_optimize_invalid_mode(self, session, hs, sample_parquet):
+        df = session.read.parquet(sample_parquet)
+        hs.create_index(df, CoveringIndexConfig("idx", ["clicks"]))
+        with pytest.raises(HyperspaceException, match="mode"):
+            hs.optimize_index("idx", "bogus")
