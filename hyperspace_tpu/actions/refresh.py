@@ -53,6 +53,7 @@ class RefreshActionBase(Action):
             self._previous.file_id_tracker() if self._previous else FileIdTracker()
         )
         self._source_rel = None
+        self._current_infos = None
 
     # -- source reconstruction (RefreshActionBase.df:54-76) -----------------
     def source_relation(self):
@@ -74,10 +75,15 @@ class RefreshActionBase(Action):
         return self._source_rel
 
     def current_file_infos(self) -> Dict[str, Tuple[int, int]]:
-        return {
-            p: (size, mtime)
-            for p, size, mtime in self.source_relation().all_file_infos()
-        }
+        # Snapshot ONCE per action: validate/op/log_entry must all see the
+        # same file view even if the source changes mid-action, and each
+        # listing is a full O(N) stat pass.
+        if self._current_infos is None:
+            self._current_infos = {
+                p: (size, mtime)
+                for p, size, mtime in self.source_relation().all_file_infos()
+            }
+        return self._current_infos
 
     # -- diffs (RefreshActionBase.deletedFiles/appendedFiles:97-128) --------
     # Diff against the raw build-time snapshot (relation.content), NOT the
@@ -233,7 +239,10 @@ class RefreshQuickAction(RefreshActionBase):
     def log_entry(self) -> IndexLogEntry:
         appended = Content.from_leaf_files(self.appended_files(), self.tracker)
         deleted_triples = []
-        prev = self._previous.source_file_info_set()
+        # look up in the same view deleted_files() diffs against — the raw
+        # build-time snapshot (a prior quick refresh already removed the
+        # path from source_file_info_set())
+        prev = self._indexed_data_files()
         for p, _fid in self.deleted_files():
             info = prev[p]
             deleted_triples.append((p, info.size, info.modified_time))

@@ -50,27 +50,160 @@ def _exec(plan: LogicalPlan, needed: Set[str], session) -> ColumnarBatch:
         right = _exec(plan.right, set(cols), session).select(cols)
         return ColumnarBatch.concat([left, right])
     if isinstance(plan, Join):
-        pairs = E.equi_join_pairs(plan.condition)
-        if pairs is None:
-            raise HyperspaceException(
-                f"Only conjunctive equi-joins are executable: {plan.condition!r}"
-            )
-        lcols = set(plan.left.output)
-        on = []
-        for a, b in pairs:
-            if a in lcols:
-                on.append((a, b))
-            else:
-                on.append((b, a))
-        l_needed = (needed & lcols) | {l for l, _ in on}
-        rcols = set(plan.right.output)
-        r_needed = (needed & rcols) | {r for _, r in on}
-        left = _exec(plan.left, l_needed, session)
-        right = _exec(plan.right, r_needed, session)
-        from hyperspace_tpu.execution.join_exec import inner_join
-
-        return inner_join(left, right, on)
+        return _exec_join(plan, needed, session)
     raise HyperspaceException(f"Unknown plan node: {type(plan).__name__}")
+
+
+def _exec_join(plan: Join, needed: Set[str], session) -> ColumnarBatch:
+    pairs = E.equi_join_pairs(plan.condition)
+    if pairs is None:
+        raise HyperspaceException(
+            f"Only conjunctive equi-joins are executable: {plan.condition!r}"
+        )
+    lcols = set(plan.left.output)
+    on = []
+    for a, b in pairs:
+        if a in lcols:
+            on.append((a, b))
+        else:
+            on.append((b, a))
+    l_needed = (needed & lcols) | {l for l, _ in on}
+    rcols = set(plan.right.output)
+    r_needed = (needed & rcols) | {r for _, r in on}
+    from hyperspace_tpu.execution.join_exec import inner_join
+
+    layout = _aligned_bucket_layouts(plan, on)
+    if layout is not None:
+        # Shuffle-free co-bucketed join (the JoinIndexRule payoff; the
+        # physical analogue of Spark SMJ over co-bucketed index scans with
+        # no Exchange, JoinIndexRule.scala:619-634): zip equal buckets.
+        num_buckets, l_bucket_cols, r_bucket_cols = layout
+        lbs = _exec_bucketed(plan.left, l_needed, session, l_bucket_cols)
+        rbs = _exec_bucketed(plan.right, r_needed, session, r_bucket_cols)
+        parts = [
+            inner_join(lbs[b], rbs[b], on)
+            for b in sorted(set(lbs) & set(rbs))
+        ]
+        if parts:
+            return ColumnarBatch.concat(parts)
+        import pyarrow as pa
+
+        schema = plan.schema()
+        out_cols = [c for c in plan.output if c in (needed | set(
+            [x for p in on for x in p]))]
+        return ColumnarBatch.from_arrow(
+            pa.table({c: pa.array([], type=schema[c]) for c in out_cols})
+        )
+    left = _exec(plan.left, l_needed, session)
+    right = _exec(plan.right, r_needed, session)
+    return inner_join(left, right, on)
+
+
+def _bucket_layout(plan: LogicalPlan):
+    """(num_buckets, bucket_cols) if the subtree preserves a bucketed scan
+    layout (Scan with bucket_spec under Filter/Project/Union)."""
+    if isinstance(plan, Scan):
+        return plan.relation.bucket_spec
+    if isinstance(plan, Filter):
+        return _bucket_layout(plan.child)
+    if isinstance(plan, Project):
+        spec = _bucket_layout(plan.child)
+        if spec and all(c in plan.columns for c in spec[1]):
+            return spec
+        return None
+    if isinstance(plan, Union):
+        # hybrid scan: the index side (left) defines the layout; the
+        # appended side is re-bucketed at execution time
+        return _bucket_layout(plan.left)
+    return None
+
+
+def _aligned_bucket_layouts(plan: Join, on):
+    """Both sides bucketed, same count, and bucket columns positionally
+    aligned through the join mapping (order matters: the bucket hash chains
+    over columns in order — mirroring Spark's order-sensitive
+    HashPartitioning compatibility)."""
+    l_spec = _bucket_layout(plan.left)
+    r_spec = _bucket_layout(plan.right)
+    if not l_spec or not r_spec:
+        return None
+    (ln, lcols), (rn, rcols) = l_spec, r_spec
+    if ln != rn or len(lcols) != len(rcols):
+        return None
+    mapping = {l: r for l, r in on}
+    for lc, rc in zip(lcols, rcols):
+        if mapping.get(lc) != rc:
+            return None
+    return ln, tuple(lcols), tuple(rcols)
+
+
+def _exec_bucketed(
+    plan: LogicalPlan, needed: Set[str], session, bucket_cols
+):
+    """Execute a linear subtree into per-bucket batches.
+
+    Index scans recover the bucket id from file names; appended (hybrid)
+    rows are hashed on device — the execution-time equivalent of the
+    reference's on-the-fly shuffle of appended data
+    (CoveringIndexRuleUtils.transformPlanToShuffleUsingBucketSpec:357-417).
+    """
+    import dataclasses
+
+    from hyperspace_tpu.io.parquet import bucket_id_of_file
+    from hyperspace_tpu.ops.hash import bucket_ids_np
+
+    if isinstance(plan, Scan):
+        groups = {}
+        for f in plan.relation.files:
+            b = bucket_id_of_file(f)
+            groups.setdefault(b, []).append(f)
+        out = {}
+        for b, files in groups.items():
+            sub = Scan(dataclasses.replace(plan.relation, files=tuple(files)))
+            out[b] = _exec_scan(sub, needed, session)
+        return out
+    if isinstance(plan, Filter):
+        child_needed = set(needed) | E.references(plan.condition)
+        out = {}
+        for b, batch in _exec_bucketed(
+            plan.child, child_needed, session, bucket_cols
+        ).items():
+            out[b] = batch.filter(_filter_mask(plan.condition, batch))
+        return out
+    if isinstance(plan, Project):
+        cols = [c for c in plan.columns if c in needed] or plan.columns
+        return {
+            b: batch.select([c for c in cols if c in batch.column_names])
+            for b, batch in _exec_bucketed(
+                plan.child, set(cols), session, bucket_cols
+            ).items()
+        }
+    if isinstance(plan, Union):
+        cols = [c for c in plan.output if c in needed] or plan.output[:1]
+        read_cols = sorted(set(cols) | set(bucket_cols))
+        left = {
+            b: batch.select(read_cols)
+            for b, batch in _exec_bucketed(
+                plan.left, set(read_cols), session, bucket_cols
+            ).items()
+        }
+        spec = _bucket_layout(plan.left)
+        num_buckets = spec[0]
+        appended = _exec(plan.right, set(read_cols), session).select(read_cols)
+        if appended.num_rows:
+            reps = appended.key_reps(list(bucket_cols))
+            bids = bucket_ids_np(reps, num_buckets)
+            for b in np.unique(bids):
+                part = appended.filter(bids == b)
+                key = int(b)
+                if key in left:
+                    left[key] = ColumnarBatch.concat([left[key], part])
+                else:
+                    left[key] = part
+        return left
+    raise HyperspaceException(
+        f"Node not supported in bucketed execution: {type(plan).__name__}"
+    )
 
 
 def _filter_mask(cond: E.Expr, batch: ColumnarBatch) -> np.ndarray:

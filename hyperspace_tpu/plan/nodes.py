@@ -257,6 +257,39 @@ class Join(LogicalPlan):
         return f"Join {self.how} on {self.condition!r}"
 
 
+def prune_join_columns(plan: LogicalPlan, needed: Optional[set] = None) -> LogicalPlan:
+    """Insert explicit Projects above Join children so each side carries
+    only the columns used above it.
+
+    The reference's rules run after Catalyst's column pruning, so
+    ``JoinIndexRule`` sees minimal child outputs; this pass provides the
+    same invariant for our IR. Only Join children are wrapped — existing
+    Filter/Project chains are preserved so the Filter-rule plan shapes
+    stay matchable.
+    """
+    if needed is None:
+        needed = set(plan.output)
+    if isinstance(plan, Project):
+        return Project(plan.columns, prune_join_columns(plan.child, set(plan.columns)))
+    if isinstance(plan, Filter):
+        child_needed = needed | E.references(plan.condition)
+        return Filter(plan.condition, prune_join_columns(plan.child, child_needed))
+    if isinstance(plan, Join):
+        refs = E.references(plan.condition)
+        out = []
+        for child in (plan.left, plan.right):
+            child_needed = (needed | refs) & set(child.output)
+            pruned = prune_join_columns(child, child_needed)
+            cols = [c for c in pruned.output if c in child_needed]
+            if cols != pruned.output:
+                pruned = Project(cols, pruned)
+            out.append(pruned)
+        return Join(out[0], out[1], plan.condition, plan.how)
+    if isinstance(plan, Union):
+        return plan  # already minimal (built by the rewrite itself)
+    return plan
+
+
 def required_columns(plan: LogicalPlan, parent_needs: Optional[set] = None) -> set:
     """Columns a subtree must produce — drives scan column pruning."""
     if parent_needs is None:

@@ -43,6 +43,9 @@ def apply_hyperspace(session, plan: LogicalPlan) -> LogicalPlan:
         entries = session.index_manager.get_indexes([States.ACTIVE])
         if not entries:
             return plan
+        from hyperspace_tpu.plan.nodes import prune_join_columns
+
+        plan = prune_join_columns(plan)
         candidates = collect_candidates(session, plan, entries)
         if not candidates:
             return plan

@@ -66,7 +66,7 @@ def file_signature_filter(
                 # and compensate at rewrite time from the recorded Update
                 # delta (the reference's exact-mode quick-refresh path,
                 # CoveringIndexRuleUtils.scala:74-79,164-170).
-                _tag_update_compensation(scan, e)
+                ok = _tag_update_compensation(scan, e)
             if not ok:
                 tag_filter_reason(e, scan, FR.source_data_changed())
         if ok:
@@ -87,9 +87,11 @@ def _signature_valid(session, scan: Scan, entry: IndexLogEntry) -> bool:
     return False
 
 
-def _tag_update_compensation(scan: Scan, entry: IndexLogEntry) -> None:
+def _tag_update_compensation(scan: Scan, entry: IndexLogEntry) -> bool:
     """Set the Hybrid-Scan compensation tags from a quick refresh's recorded
-    Update delta (no file diffing needed — the delta is in the metadata)."""
+    Update delta (no file diffing needed — the delta is in the metadata).
+    Returns False (reject) for recorded deletes on a lineage-less index —
+    there is no way to exclude the dead rows."""
     upd = entry.relation.update
     appended = (
         [p for p, _ in upd.appended_files.file_infos] if upd.appended_files else []
@@ -99,12 +101,19 @@ def _tag_update_compensation(scan: Scan, entry: IndexLogEntry) -> None:
         if upd.deleted_files
         else []
     )
+    has_deletes = upd.deleted_files is not None and bool(
+        upd.deleted_files.files
+    )
+    if has_deletes and not entry.derived_dataset.can_handle_deleted_files:
+        tag_filter_reason(entry, scan, FR.no_delete_support())
+        return False
     entry.set_tag(
         scan, tags.COMMON_SOURCE_SIZE_IN_BYTES, entry.relation.content.size_in_bytes
     )
     entry.set_tag(scan, tags.HYBRIDSCAN_REQUIRED, True)
     entry.set_tag(scan, tags.HYBRIDSCAN_APPENDED, appended)
     entry.set_tag(scan, tags.HYBRIDSCAN_DELETED, deleted_ids)
+    return True
 
 
 def _hybrid_scan_candidate(session, scan: Scan, entry: IndexLogEntry) -> bool:

@@ -32,8 +32,9 @@ def transform_plan_to_use_hybrid_scan(
     index_rel = index_scan_relation(
         session,
         entry,
-        # bucket-pruning claims break once raw appended rows are unioned in
-        use_bucket_spec=use_bucket_spec and not appended,
+        # layout survives the union: appended rows are re-bucketed at
+        # execution time (executor._exec_bucketed's Union branch)
+        use_bucket_spec=use_bucket_spec,
         excluded_file_ids=tuple(deleted_ids) if deleted_ids else None,
     )
     index_scan = Scan(index_rel)

@@ -1,0 +1,186 @@
+"""JoinIndexRule E2E + eligibility tests.
+
+Mirrors ``covering/JoinIndexRuleTest.scala`` (eligibility filters) and the
+join scenarios of ``E2EHyperspaceRulesTest`` (both sides rewritten, results
+equal to the un-indexed run).
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+
+
+@pytest.fixture
+def hs(session):
+    return Hyperspace(session)
+
+
+@pytest.fixture
+def join_tables(tmp_path):
+    rng = np.random.default_rng(11)
+    n1, n2 = 400, 600
+    orders = pa.table(
+        {
+            "o_key": pa.array(rng.integers(0, 80, n1), type=pa.int64()),
+            "o_amount": pa.array(rng.normal(100, 20, n1)),
+            "o_tag": pa.array([f"t{int(x) % 4}" for x in rng.integers(0, 99, n1)]),
+        }
+    )
+    items = pa.table(
+        {
+            "l_key": pa.array(rng.integers(0, 80, n2), type=pa.int64()),
+            "l_qty": pa.array(rng.integers(1, 9, n2), type=pa.int64()),
+        }
+    )
+    d1, d2 = tmp_path / "orders", tmp_path / "items"
+    d1.mkdir(), d2.mkdir()
+    for i in range(2):
+        pq.write_table(orders.slice(i * 200, 200), d1 / f"p{i}.parquet")
+    for i in range(3):
+        pq.write_table(items.slice(i * 200, 200), d2 / f"p{i}.parquet")
+    return str(d1), str(d2)
+
+
+def sorted_table(t):
+    return t.sort_by([(c, "ascending") for c in t.column_names])
+
+
+class TestJoinIndexRule:
+    def _mk_indexes(self, session, hs, d1, d2):
+        dfo = session.read.parquet(d1)
+        dfi = session.read.parquet(d2)
+        hs.create_index(dfo, CoveringIndexConfig("o_idx", ["o_key"], ["o_amount"]))
+        hs.create_index(dfi, CoveringIndexConfig("l_idx", ["l_key"], ["l_qty"]))
+        return dfo, dfi
+
+    def test_join_rewritten_both_sides_and_matches(
+        self, session, hs, join_tables
+    ):
+        d1, d2 = join_tables
+        dfo, dfi = self._mk_indexes(session, hs, d1, d2)
+        q = lambda o, i: (
+            o.join(i, on=o["o_key"] == i["l_key"])
+            .select("o_key", "o_amount", "l_qty")
+        )
+        session.disable_hyperspace()
+        base = q(dfo, dfi).collect()
+        session.enable_hyperspace()
+        plan = q(dfo, dfi).explain()
+        assert plan.count("Hyperspace(Type: CI") == 2, plan
+        assert "o_idx" in plan and "l_idx" in plan
+        got = q(dfo, dfi).collect()
+        assert sorted_table(got).equals(sorted_table(base))
+        assert got.num_rows > 0
+
+    def test_join_with_filter_sides(self, session, hs, join_tables):
+        d1, d2 = join_tables
+        dfo, dfi = self._mk_indexes(session, hs, d1, d2)
+        q = lambda o, i: (
+            o.filter(o["o_key"] > 10)
+            .join(i, on=o["o_key"] == i["l_key"])
+            .select("o_key", "l_qty")
+        )
+        session.disable_hyperspace()
+        base = q(dfo, dfi).collect()
+        session.enable_hyperspace()
+        plan = q(dfo, dfi).explain()
+        assert plan.count("Hyperspace(Type: CI") == 2, plan
+        got = q(dfo, dfi).collect()
+        assert sorted_table(got).equals(sorted_table(base))
+
+    def test_join_not_rewritten_when_columns_uncovered(
+        self, session, hs, join_tables
+    ):
+        d1, d2 = join_tables
+        dfo, dfi = self._mk_indexes(session, hs, d1, d2)
+        session.enable_hyperspace()
+        # o_tag is not covered by o_idx
+        q = (
+            dfo.join(dfi, on=dfo["o_key"] == dfi["l_key"])
+            .select("o_key", "o_tag", "l_qty")
+        )
+        assert "Hyperspace" not in q.explain()
+
+    def test_join_not_rewritten_when_index_on_wrong_column(
+        self, session, hs, join_tables
+    ):
+        d1, d2 = join_tables
+        dfo = session.read.parquet(d1)
+        dfi = session.read.parquet(d2)
+        # index on o_amount, join on o_key -> indexed != join cols
+        hs.create_index(dfo, CoveringIndexConfig("o_bad", ["o_amount"], ["o_key"]))
+        hs.create_index(dfi, CoveringIndexConfig("l_idx", ["l_key"], ["l_qty"]))
+        session.enable_hyperspace()
+        q = (
+            dfo.join(dfi, on=dfo["o_key"] == dfi["l_key"])
+            .select("o_key", "o_amount", "l_qty")
+        )
+        assert "Hyperspace" not in q.explain()
+
+    def test_join_beats_filter_rule_on_score(self, session, hs, join_tables):
+        """Join rewrite (70+70) must win over per-side filter rewrites."""
+        d1, d2 = join_tables
+        dfo, dfi = self._mk_indexes(session, hs, d1, d2)
+        session.enable_hyperspace()
+        q = (
+            dfo.filter(dfo["o_key"] > 0)
+            .join(dfi, on=dfo["o_key"] == dfi["l_key"])
+            .select("o_key", "l_qty")
+        )
+        plan = q.explain()
+        assert plan.count("Hyperspace(Type: CI") == 2
+
+    def test_join_hybrid_appended_rows(self, session, hs, join_tables):
+        d1, d2 = join_tables
+        dfo, dfi = self._mk_indexes(session, hs, d1, d2)
+        # append to the items side after indexing
+        extra = pa.table(
+            {
+                "l_key": pa.array([5, 7, 7], type=pa.int64()),
+                "l_qty": pa.array([100, 200, 300], type=pa.int64()),
+            }
+        )
+        pq.write_table(extra, os.path.join(d2, "extra.parquet"))
+        session.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, True)
+        session.index_manager.clear_cache()
+        dfi2 = session.read.parquet(d2)
+        q = lambda o, i: (
+            o.join(i, on=o["o_key"] == i["l_key"]).select("o_key", "l_qty")
+        )
+        session.disable_hyperspace()
+        base = q(dfo, dfi2).collect()
+        session.enable_hyperspace()
+        plan = q(dfo, dfi2).explain()
+        assert plan.count("Hyperspace(Type: CI") == 2
+        assert "Union" in plan
+        got = q(dfo, dfi2).collect()
+        assert sorted_table(got).equals(sorted_table(base))
+        assert 300 in got.column("l_qty").to_pylist()
+
+    def test_string_key_join_with_index(self, session, hs, tmp_path):
+        a = pa.table(
+            {"tag_a": ["x", "y", "z", "x", "w"], "va": [1, 2, 3, 4, 5]}
+        )
+        b = pa.table({"tag_b": ["x", "x", "q", "z"], "vb": [10, 20, 30, 40]})
+        (tmp_path / "a").mkdir(), (tmp_path / "b").mkdir()
+        pq.write_table(a, tmp_path / "a" / "p.parquet")
+        pq.write_table(b, tmp_path / "b" / "p.parquet")
+        dfa = session.read.parquet(str(tmp_path / "a"))
+        dfb = session.read.parquet(str(tmp_path / "b"))
+        hs.create_index(dfa, CoveringIndexConfig("a_idx", ["tag_a"], ["va"]))
+        hs.create_index(dfb, CoveringIndexConfig("b_idx", ["tag_b"], ["vb"]))
+        session.enable_hyperspace()
+        q = dfa.join(dfb, on=dfa["tag_a"] == dfb["tag_b"]).select("va", "vb")
+        plan = q.explain()
+        assert plan.count("Hyperspace(Type: CI") == 2
+        pairs = sorted(
+            zip(q.collect().column("va").to_pylist(), q.collect().column("vb").to_pylist())
+        )
+        assert pairs == [(1, 10), (1, 20), (3, 40), (4, 10), (4, 20)]

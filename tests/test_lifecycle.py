@@ -280,6 +280,43 @@ class TestRefresh:
         assert "appended" in got.column("query").to_pylist()
 
 
+    def test_quick_refresh_delete_without_lineage_rejected_not_crashed(
+        self, session, hs, sample_parquet
+    ):
+        """Exact-mode queries must reject (not crash on) a lineage-less
+        quick-refreshed index that recorded deletes."""
+        self._mk(session, hs, sample_parquet, lineage=False)
+        os.remove(os.path.join(sample_parquet, "part-0.parquet"))
+        hs.refresh_index("idx", "quick")
+        session.enable_hyperspace()
+        session.index_manager.clear_cache()
+        df2 = session.read.parquet(sample_parquet)
+        q = lambda d: d.filter(d["clicks"] >= 0).select("clicks", "query")
+        plan = q(df2).explain()
+        assert "Hyperspace" not in plan
+        assert q(df2).count() == 200  # correct results from source scan
+
+    def test_second_quick_refresh_after_delete(
+        self, session, hs, sample_parquet
+    ):
+        self._mk(session, hs, sample_parquet, lineage=True)
+        os.remove(os.path.join(sample_parquet, "part-0.parquet"))
+        hs.refresh_index("idx", "quick")
+        append_file(sample_parquet)
+        hs.refresh_index("idx", "quick")  # must not KeyError
+        session.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, True)
+        session.enable_hyperspace()
+        session.index_manager.clear_cache()
+        df2 = session.read.parquet(sample_parquet)
+        q = lambda d: d.filter(d["clicks"] >= 0).select("clicks", "query")
+        session.disable_hyperspace()
+        base = q(df2).collect()
+        session.enable_hyperspace()
+        got = q(df2).collect()
+        assert sorted_table(got).equals(sorted_table(base))
+        assert got.num_rows == 203
+
+
 class TestOptimize:
     def test_optimize_compacts_buckets(self, session, hs, sample_parquet):
         df = session.read.parquet(sample_parquet)
