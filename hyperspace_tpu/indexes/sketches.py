@@ -1,0 +1,385 @@
+"""Sketches for the data-skipping index.
+
+Reference: ``dataskipping/sketches/`` — ``Sketch.scala:36-119`` (the
+expressions/aggregate/convertPredicate contract), ``MinMaxSketch.scala``
+(range pruning for =,<,≤,>,≥,In), ``BloomFilterSketch.scala`` (equality/In
+membership pruning), ``PartitionSketch.scala`` (constant-per-file
+columns). A sketch aggregates one source file into a few cells of the
+sketch table and converts query conjuncts into keep-masks over its rows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple, Type
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.io.columnar import Column, ColumnarBatch
+from hyperspace_tpu.ops.bloom import _bit_indices
+from hyperspace_tpu.ops.hash import split_words_np
+from hyperspace_tpu.plan import expressions as E
+from hyperspace_tpu.utils.hashing import murmur3_64_bytes
+
+_SKETCH_REGISTRY: Dict[str, Type["Sketch"]] = {}
+
+
+def register_sketch(cls):
+    _SKETCH_REGISTRY[cls.kind] = cls
+    return cls
+
+
+def sketch_from_dict(d: dict) -> "Sketch":
+    cls = _SKETCH_REGISTRY.get(d.get("type"))
+    if cls is None:
+        raise HyperspaceException(f"Unknown sketch kind: {d.get('type')!r}")
+    return cls.from_dict(d)
+
+
+def _column_min_max(col: Column):
+    """(min, max) python values of a Column, ignoring nulls; None if all
+    null/empty."""
+    if col.kind == "string":
+        mask = col.codes >= 0
+        if not mask.any():
+            return None, None
+        present = sorted({col.dictionary[c] for c in col.codes[mask]})
+        return present[0], present[-1]
+    v = col.values
+    if col.validity is not None:
+        v = v[col.validity]
+    if len(v) == 0:
+        return None, None
+    return v.min().item(), v.max().item()
+
+
+def _normalize_conjunct(expr: E.Expr):
+    """-> (op, column_name, literal) for Col-vs-Lit comparisons, else None."""
+    if not isinstance(expr, (E.Eq, E.Ne, E.Lt, E.Le, E.Gt, E.Ge)):
+        return None
+    left, right, op = expr.left, expr.right, expr.op
+    flipped = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "!=": "!="}
+    if isinstance(left, E.Lit) and isinstance(right, E.Col):
+        left, right, op = right, left, flipped[op]
+    if isinstance(left, E.Col) and isinstance(right, E.Lit):
+        if right.value is None:
+            return None
+        return op, left.name, right.value
+    return None
+
+
+class Sketch:
+    kind = "Sketch"
+
+    def __init__(self, column: str):
+        self.column = column
+        # arrow type string of the source column, resolved at index
+        # creation; literals are coerced against it at probe time
+        self.source_type: Optional[str] = None
+
+    # -- identity / serialization ------------------------------------------
+    def __eq__(self, other):
+        return type(self) is type(other) and self.to_dict() == other.to_dict()
+
+    def __repr__(self):
+        return f"{self.kind}({self.column})"
+
+    def to_dict(self) -> dict:
+        d = {"type": self.kind, "column": self.column}
+        if self.source_type is not None:
+            d["sourceType"] = self.source_type
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Sketch":
+        s = cls(d["column"])
+        s.source_type = d.get("sourceType")
+        return s
+
+    # -- contract -----------------------------------------------------------
+    def referenced_columns(self) -> List[str]:
+        return [self.column]
+
+    def output_fields(self, source_type: pa.DataType) -> List[Tuple[str, pa.DataType]]:
+        raise NotImplementedError
+
+    def aggregate(self, batch: ColumnarBatch) -> Dict[str, Any]:
+        """One source file's batch -> sketch cell values."""
+        raise NotImplementedError
+
+    def convert_predicate(
+        self, expr: E.Expr, table: pa.Table
+    ) -> Optional[np.ndarray]:
+        """Keep-mask over sketch rows for one conjunct, or None if this
+        sketch cannot decide it (Sketch.convertPredicate contract)."""
+        return None
+
+
+@register_sketch
+class MinMaxSketch(Sketch):
+    kind = "MinMaxSketch"
+
+    def output_fields(self, source_type):
+        return [
+            (f"MinMax_{self.column}__min", source_type),
+            (f"MinMax_{self.column}__max", source_type),
+        ]
+
+    def aggregate(self, batch):
+        lo, hi = _column_min_max(batch.column(self.column))
+        return {
+            f"MinMax_{self.column}__min": lo,
+            f"MinMax_{self.column}__max": hi,
+        }
+
+    def convert_predicate(self, expr, table):
+        lo_name = f"MinMax_{self.column}__min"
+        if lo_name not in table.column_names:
+            return None
+        lo = np.asarray(table.column(lo_name).to_pylist(), dtype=object)
+        hi = np.asarray(
+            table.column(f"MinMax_{self.column}__max").to_pylist(), dtype=object
+        )
+        valid = np.array([x is not None for x in lo])
+
+        def cmp(op, lit):
+            out = np.zeros(len(lo), dtype=bool)
+            for i in range(len(lo)):
+                if not valid[i]:
+                    continue  # all-null file can't match a non-null literal
+                out[i] = {
+                    "=": lo[i] <= lit <= hi[i],
+                    "<": lo[i] < lit,
+                    "<=": lo[i] <= lit,
+                    ">": hi[i] > lit,
+                    ">=": hi[i] >= lit,
+                }[op]
+            return out
+
+        if isinstance(expr, E.In):
+            if (
+                isinstance(expr.child, E.Col)
+                and expr.child.name.lower() == self.column.lower()
+            ):
+                try:
+                    masks = [cmp("=", v) for v in expr.values if v is not None]
+                except TypeError:  # incomparable literal type
+                    return None
+                if not masks:
+                    return np.zeros(len(lo), dtype=bool)
+                return np.logical_or.reduce(masks)
+            return None
+        norm = _normalize_conjunct(expr)
+        if norm is None:
+            return None
+        op, col, lit = norm
+        if col.lower() != self.column.lower() or op == "!=":
+            return None
+        try:
+            return cmp(op, lit)
+        except TypeError:  # incomparable literal type
+            return None
+
+
+@register_sketch
+class BloomFilterSketch(Sketch):
+    kind = "BloomFilterSketch"
+
+    def __init__(self, column: str, fpp: float = 0.01, expected_items: int = 10000):
+        super().__init__(column)
+        self.fpp = float(fpp)
+        self.expected_items = int(expected_items)
+        from hyperspace_tpu.ops.bloom import optimal_params
+
+        self.m, self.k = optimal_params(self.expected_items, self.fpp)
+
+    def to_dict(self):
+        d = {
+            "type": self.kind,
+            "column": self.column,
+            "fpp": self.fpp,
+            "expectedItems": self.expected_items,
+        }
+        if self.source_type is not None:
+            d["sourceType"] = self.source_type
+        return d
+
+    @classmethod
+    def from_dict(cls, d):
+        s = cls(d["column"], d.get("fpp", 0.01), d.get("expectedItems", 10000))
+        s.source_type = d.get("sourceType")
+        return s
+
+    def output_fields(self, source_type):
+        return [(f"BloomFilter_{self.column}__bits", pa.binary())]
+
+    def aggregate(self, batch):
+        from hyperspace_tpu.ops.bloom import build_bloom
+
+        col = batch.column(self.column)
+        reps = col.key_rep()
+        nulls = col.null_mask
+        if nulls is not None:
+            reps = reps[~nulls]
+        words = build_bloom(reps, self.m, self.k)
+        return {f"BloomFilter_{self.column}__bits": words.tobytes()}
+
+    def _probe(self, table: pa.Table, values) -> Optional[np.ndarray]:
+        name = f"BloomFilter_{self.column}__bits"
+        if name not in table.column_names:
+            return None
+        reps = []
+        for v in values:
+            rep = _value_rep(v, self.source_type)
+            if rep is _ABSTAIN:
+                return None  # un-coercible literal: this sketch can't decide
+            if rep is not _NO_MATCH:
+                reps.append(rep)
+        blobs = table.column(name).to_pylist()
+        if not reps:  # every literal is outside the column's value domain
+            return np.zeros(len(blobs), dtype=bool)
+        blooms = np.stack(
+            [
+                np.frombuffer(b, dtype=np.uint64)
+                if b
+                else np.zeros(self.m // 64, dtype=np.uint64)
+                for b in blobs
+            ]
+        )
+        idx = np.asarray(
+            _bit_indices(
+                jnp.asarray(split_words_np(np.array(reps, dtype=np.int64)[None, :])),
+                self.m,
+                self.k,
+            )
+        )  # [k, n_values]
+        widx, bit = idx >> 6, (idx & 63).astype(np.uint64)
+        # hits[f, j] = all k bits of value j set in bloom f
+        hits = (
+            (blooms[:, widx] >> bit[None, :, :]) & np.uint64(1)
+        ).all(axis=1)
+        return hits.any(axis=1)
+
+    def convert_predicate(self, expr, table):
+        if isinstance(expr, E.In):
+            if (
+                isinstance(expr.child, E.Col)
+                and expr.child.name.lower() == self.column.lower()
+            ):
+                vals = [v for v in expr.values if v is not None]
+                return self._probe(table, vals)
+            return None
+        norm = _normalize_conjunct(expr)
+        if norm is None:
+            return None
+        op, col, lit = norm
+        if col.lower() != self.column.lower() or op != "=":
+            return None
+        return self._probe(table, [lit])
+
+
+_ABSTAIN = object()  # literal un-coercible -> sketch cannot decide
+_NO_MATCH = object()  # literal outside the column's domain -> matches nothing
+
+
+def _value_rep(v, source_type: Optional[str]):
+    """Literal -> the int64 key rep io/columnar assigns to the COLUMN's
+    values, coercing the literal to the column's type first (an int column
+    probed with 2050.0 must hash the integer 2050; a probe the executor
+    would match must never be pruned away)."""
+    if source_type is None:
+        return _ABSTAIN
+    t = source_type
+    if t in ("string", "large_string"):
+        if not isinstance(v, str):
+            return _ABSTAIN
+        return murmur3_64_bytes(v.encode("utf-8"))
+    if t == "bool":
+        return int(bool(v))
+    if t.startswith("int") or t.startswith("uint"):
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return _ABSTAIN
+        if isinstance(v, float):
+            if not v.is_integer():
+                return _NO_MATCH
+            v = int(v)
+        return int(v)
+    if t in ("float", "double", "halffloat"):
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return _ABSTAIN
+        f = np.float64(v)
+        if f == 0.0:
+            return 0
+        return int(f.view(np.int64))
+    return _ABSTAIN
+
+
+@register_sketch
+class PartitionSketch(Sketch):
+    """Constant-per-file column values (the reference auto-adds this for
+    hive-partitioned sources, PartitionSketch.scala:38-74; ours detects
+    constancy per file at build time, which also covers partition dirs)."""
+
+    kind = "PartitionSketch"
+
+    def output_fields(self, source_type):
+        return [
+            (f"Partition_{self.column}__val", source_type),
+            (f"Partition_{self.column}__const", pa.bool_()),
+        ]
+
+    def aggregate(self, batch):
+        col = batch.column(self.column)
+        val, const = None, False
+        if batch.num_rows:
+            if col.kind == "string":
+                codes = np.unique(col.codes)
+                const = len(codes) == 1
+                if const and codes[0] >= 0:
+                    val = col.dictionary[codes[0]]
+            else:
+                v = col.values
+                if col.validity is None or col.validity.all():
+                    const = bool((v == v[0]).all()) if len(v) else False
+                    if const:
+                        val = v[0].item()
+        return {
+            f"Partition_{self.column}__val": val,
+            f"Partition_{self.column}__const": const,
+        }
+
+    def convert_predicate(self, expr, table):
+        name = f"Partition_{self.column}__val"
+        if name not in table.column_names:
+            return None
+        vals = table.column(name).to_pylist()
+        const = np.asarray(table.column(f"Partition_{self.column}__const"))
+
+        def eq_mask(lit):
+            return np.array(
+                [
+                    (not c) or (v is not None and v == lit)
+                    for v, c in zip(vals, const)
+                ]
+            )
+
+        if isinstance(expr, E.In):
+            if (
+                isinstance(expr.child, E.Col)
+                and expr.child.name.lower() == self.column.lower()
+            ):
+                masks = [eq_mask(v) for v in expr.values if v is not None]
+                if not masks:
+                    return np.zeros(len(vals), dtype=bool)
+                return np.logical_or.reduce(masks)
+            return None
+        norm = _normalize_conjunct(expr)
+        if norm is None:
+            return None
+        op, col, lit = norm
+        if col.lower() != self.column.lower() or op != "=":
+            return None
+        return eq_mask(lit)

@@ -127,16 +127,23 @@ class _Prep:
             if ref is not None:
                 ranks = []
                 for v in vals:
-                    lo, hi = ref.rank_bounds(str(v))
+                    if not isinstance(v, str):
+                        continue  # non-string literal never matches
+                    lo, hi = ref.rank_bounds(v)
                     if hi > lo:
                         ranks.append(lo)
                 arr = np.array(sorted(ranks) or [-1], dtype=np.int64)
             else:
-                try:
-                    arr = np.sort(np.array(vals))
-                except Exception as ex:  # mixed-type lits etc.
-                    raise Unsupported(f"IN literal set: {e!r}") from ex
-                if arr.dtype == object:
+                # type-compatible literals only (host path does the same)
+                lits = [
+                    v
+                    for v in vals
+                    if isinstance(v, (int, float)) and not isinstance(v, bool)
+                ]
+                if not lits:
+                    return ("const", False)
+                arr = np.sort(np.array(lits))
+                if arr.dtype.kind not in "iuf":
                     raise Unsupported(f"IN literal set: {e!r}")
             return ("in", cspec, self._arg(arr))
         raise Unsupported(f"Expression not device-compilable: {e!r}")

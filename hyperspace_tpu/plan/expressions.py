@@ -413,11 +413,22 @@ def evaluate(expr: Expr, batch) -> Tuple[np.ndarray, Optional[np.ndarray]]:
             raise HyperspaceException("IN requires a column operand")
         vref, valid = _column_ref(batch, expr.child.name)
         if isinstance(vref, _StringRef):
-            codes = {vref.code_of(str(v)) for v in expr.values if v is not None}
+            codes = {
+                vref.code_of(v) for v in expr.values if isinstance(v, str)
+            }
             codes.discard(-2)
             vals = np.isin(vref.codes, np.array(sorted(codes), dtype=np.int64))
             return vals, vref.valid
-        lits = [v for v in expr.values if v is not None]
+        # keep only type-compatible literals: 5 matches isin(5, "a") on an
+        # int column; the string literal can never match and must not
+        # poison the comparison dtype
+        lits = [
+            v
+            for v in expr.values
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        ]
+        if not lits:
+            return np.zeros(n, bool), valid
         vals = np.isin(vref, np.array(lits))
         return vals, valid
     raise HyperspaceException(f"Cannot evaluate expression: {expr!r}")

@@ -1,0 +1,98 @@
+"""ApplyDataSkippingIndex — prune source files via the sketch table.
+
+Reference: ``dataskipping/rules/ApplyDataSkippingIndex.scala:33-105`` +
+``FilterConditionFilter`` (translate the predicate, tag it) +
+``DataSkippingIndexRanker``. Score = 1, so any covering-index rewrite wins
+(`:76-83`). The rewritten plan scans the SAME source relation with a
+reduced file list (the reference's ``DataSkippingFileIndex`` evaluates the
+translated predicate against the sketch and collects surviving paths
+driver-side, ``DataSkippingFileIndex.scala:49-56``; we evaluate at rewrite
+time — the sketch table is one row per file).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+
+from hyperspace_tpu.constants import DATA_FILE_NAME_ID
+from hyperspace_tpu.io import parquet as pio
+from hyperspace_tpu.metadata.entry import IndexLogEntry
+from hyperspace_tpu.plan.nodes import Filter, LogicalPlan, Project, Scan
+from hyperspace_tpu.plananalysis import filter_reasons as FR
+from hyperspace_tpu.rules import tags
+from hyperspace_tpu.rules.base import CandidateMap, HyperspaceRule, tag_filter_reason
+from hyperspace_tpu.rules.filter_rule import _match
+
+
+class ApplyDataSkippingIndex(HyperspaceRule):
+    name = "ApplyDataSkippingIndex"
+    base_score = 1
+
+    def apply(self, session, plan, candidates: CandidateMap):
+        m = _match(plan)
+        if m is None:
+            return plan, 0
+        project, filt, scan = m
+        entries = [
+            e
+            for e in candidates.get(scan, [])
+            if e.derived_dataset.kind == "DataSkippingIndex"
+        ]
+        best: Optional[IndexLogEntry] = None
+        best_files: Optional[List[str]] = None
+        for e in sorted(entries, key=lambda e: e.name):
+            files = self._pruned_files(session, e, scan, filt)
+            if files is None:
+                continue
+            if best_files is None or len(files) < len(best_files):
+                best, best_files = e, files
+        if best is None:
+            return plan, 0
+        appended = best.get_tag(scan, tags.HYBRIDSCAN_APPENDED) or []
+        # A file modified in place appears BOTH in the (stale) sketch keep
+        # list and in the appended tag — scan it once, unpruned, via the
+        # appended list only.
+        appended_set = set(appended)
+        pruned = [p for p in best_files if p not in appended_set]
+        new_rel = dataclasses.replace(
+            scan.relation,
+            files=tuple(pruned) + tuple(appended),
+            index_info=(best.name, best.id, best.derived_dataset.kind_abbr),
+        )
+        new_plan: LogicalPlan = Filter(filt.condition, Scan(new_rel))
+        new_plan = Project(
+            project.columns if project is not None else plan.output, new_plan
+        )
+        return new_plan, self.base_score
+
+    def _pruned_files(self, session, entry, scan, filt) -> Optional[List[str]]:
+        index = entry.derived_dataset
+        if not entry.content.files:
+            return None
+        sketch_table = pio.read_table(list(entry.content.files), None)
+        mask = index.translate_filter(filt.condition, sketch_table)
+        if mask is None:
+            tag_filter_reason(
+                entry,
+                scan,
+                FR.ineligible_predicate(
+                    f"no sketch matches predicate {filt.condition!r}"
+                ),
+            )
+            return None
+        ids = np.asarray(sketch_table.column(DATA_FILE_NAME_ID))
+        keep_ids = set(ids[mask].tolist())
+        id_to_path = {
+            info.id: path
+            for path, info in entry.relation.content.file_infos
+        }
+        current = set(scan.relation.files)
+        out = [
+            p
+            for fid, p in sorted(id_to_path.items())
+            if fid in keep_ids and p in current
+        ]
+        return out

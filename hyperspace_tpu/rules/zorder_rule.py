@@ -1,0 +1,21 @@
+"""ZOrderFilterIndexRule.
+
+Reference: ``zordercovering/ZOrderFilterIndexRule.scala:36-153`` — the
+FilterIndexRule variant for z-order covering indexes: ANY indexed column
+(not only the first) may appear in the predicate, and no bucketSpec is
+attached (z-order files are range-laid-out, not hash-bucketed).
+"""
+
+from __future__ import annotations
+
+from hyperspace_tpu.rules.filter_rule import FilterIndexRule
+
+
+class ZOrderFilterIndexRule(FilterIndexRule):
+    # The class attributes fully specialize the parent pipeline; z-order
+    # relations never get a bucketSpec because ZOrderCoveringIndex has no
+    # num_buckets (index_scan_relation checks hasattr).
+    name = "ZOrderFilterIndexRule"
+    index_kind = "ZOrderCoveringIndex"
+    require_first_indexed_col = False
+    base_score = 50

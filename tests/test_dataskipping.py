@@ -1,0 +1,281 @@
+"""Data-skipping index tests.
+
+Mirrors ``dataskipping/DataSkippingIndexIntegrationTest.scala`` and the
+sketch unit suites: per-file sketch build, predicate translation,
+file pruning at serve time, refresh, and losing to covering on score.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.indexes.dataskipping import DataSkippingIndexConfig
+from hyperspace_tpu.indexes.sketches import (
+    BloomFilterSketch,
+    MinMaxSketch,
+    PartitionSketch,
+)
+
+
+@pytest.fixture
+def hs(session):
+    return Hyperspace(session)
+
+
+@pytest.fixture
+def ranged_parquet(tmp_path):
+    """4 files with disjoint clicks ranges -> ideal for min/max pruning."""
+    d = tmp_path / "ranged"
+    d.mkdir()
+    for i in range(4):
+        t = pa.table(
+            {
+                "clicks": pa.array(
+                    range(i * 1000, i * 1000 + 100), type=pa.int64()
+                ),
+                "name": [f"file{i}"] * 100,
+                "part": [f"p{i}"] * 100,
+            }
+        )
+        pq.write_table(t, d / f"f{i}.parquet")
+    return str(d)
+
+
+def scanned_files(session, df_plan):
+    leaves = session.optimize(df_plan).collect_leaves()
+    return leaves[0].relation.files
+
+
+def sorted_table(t):
+    return t.sort_by([(c, "ascending") for c in t.column_names])
+
+
+class TestMinMaxSkipping:
+    def test_prunes_files_and_matches(self, session, hs, ranged_parquet):
+        df = session.read.parquet(ranged_parquet)
+        hs.create_index(
+            df, DataSkippingIndexConfig("ds", MinMaxSketch("clicks"))
+        )
+        session.enable_hyperspace()
+        q = lambda d: d.filter(d["clicks"] == 2050).select("clicks", "name")
+        plan_files = scanned_files(session, q(df).logical_plan)
+        assert len(plan_files) == 1 and "f2.parquet" in plan_files[0]
+        plan = q(df).explain()
+        assert "Hyperspace(Type: DS, Name: ds" in plan
+        session.disable_hyperspace()
+        base = q(df).collect()
+        session.enable_hyperspace()
+        got = q(df).collect()
+        assert sorted_table(got).equals(sorted_table(base))
+        assert got.num_rows == 1
+
+    def test_range_and_in_predicates(self, session, hs, ranged_parquet):
+        df = session.read.parquet(ranged_parquet)
+        hs.create_index(
+            df, DataSkippingIndexConfig("ds", MinMaxSketch("clicks"))
+        )
+        session.enable_hyperspace()
+        f = scanned_files(
+            session, df.filter(df["clicks"] < 1050).select("clicks").logical_plan
+        )
+        assert len(f) == 2  # f0 fully, f1 partially
+        f = scanned_files(
+            session,
+            df.filter(df["clicks"].isin(5, 3005)).select("clicks").logical_plan,
+        )
+        assert len(f) == 2
+        # conjunct with untranslatable part still prunes on the other
+        f = scanned_files(
+            session,
+            df.filter((df["clicks"] == 5) & (df["name"] != "x"))
+            .select("clicks")
+            .logical_plan,
+        )
+        assert len(f) == 1
+
+    def test_untranslatable_predicate_no_rewrite(
+        self, session, hs, ranged_parquet
+    ):
+        df = session.read.parquet(ranged_parquet)
+        hs.create_index(
+            df, DataSkippingIndexConfig("ds", MinMaxSketch("clicks"))
+        )
+        session.enable_hyperspace()
+        plan = df.filter(df["name"] == "file1").select("name").explain()
+        assert "Hyperspace" not in plan
+
+
+class TestBloomSkipping:
+    def test_bloom_prunes_string_equality(self, session, hs, ranged_parquet):
+        df = session.read.parquet(ranged_parquet)
+        hs.create_index(
+            df,
+            DataSkippingIndexConfig(
+                "dsb", BloomFilterSketch("name", 0.01, 1000)
+            ),
+        )
+        session.enable_hyperspace()
+        q = lambda d: d.filter(d["name"] == "file3").select("clicks", "name")
+        files = scanned_files(session, q(df).logical_plan)
+        assert len(files) == 1 and "f3.parquet" in files[0]
+        session.disable_hyperspace()
+        base = q(df).collect()
+        session.enable_hyperspace()
+        assert sorted_table(q(df).collect()).equals(sorted_table(base))
+
+    def test_bloom_float_literal_on_int_column(self, session, hs, ranged_parquet):
+        """A float literal the executor would match (2050.0 == 2050) must
+        NOT be pruned away by bit-exact rep hashing."""
+        df = session.read.parquet(ranged_parquet)
+        hs.create_index(
+            df,
+            DataSkippingIndexConfig("dsb", BloomFilterSketch("clicks", 0.01, 1000)),
+        )
+        session.enable_hyperspace()
+        q = lambda d: d.filter(d["clicks"] == 2050.0).select("clicks")
+        session.disable_hyperspace()
+        base = q(df).collect()
+        session.enable_hyperspace()
+        got = q(df).collect()
+        assert got.num_rows == base.num_rows == 1
+        # non-integral literal matches nothing -> pruned to zero files
+        files = scanned_files(
+            session, df.filter(df["clicks"] == 2050.5).select("clicks").logical_plan
+        )
+        assert files == ()
+
+    def test_minmax_in_with_incomparable_literal(self, session, hs, ranged_parquet):
+        """One bad IN value must make the sketch abstain, not kill the
+        whole optimizer pass."""
+        df = session.read.parquet(ranged_parquet)
+        hs.create_index(
+            df, DataSkippingIndexConfig("ds", MinMaxSketch("clicks"))
+        )
+        session.enable_hyperspace()
+        # untranslatable -> no DS rewrite, but no crash/fallback either
+        out = df.filter(df["clicks"].isin(5, "a")).select("clicks").collect()
+        assert out.num_rows == 1
+
+    def test_modified_file_not_scanned_twice_hybrid(
+        self, session, hs, ranged_parquet
+    ):
+        df = session.read.parquet(ranged_parquet)
+        hs.create_index(
+            df, DataSkippingIndexConfig("ds", MinMaxSketch("clicks"))
+        )
+        # overwrite f2 in place, keeping a matching row
+        pq.write_table(
+            pa.table(
+                {
+                    "clicks": pa.array([2050, 2051], type=pa.int64()),
+                    "name": ["file2x"] * 2,
+                    "part": ["p2"] * 2,
+                }
+            ),
+            os.path.join(ranged_parquet, "f2.parquet"),
+        )
+        session.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, True)
+        session.enable_hyperspace()
+        session.index_manager.clear_cache()
+        df2 = session.read.parquet(ranged_parquet)
+        q = lambda d: d.filter(d["clicks"] == 2050).select("clicks", "name")
+        session.disable_hyperspace()
+        base = q(df2).collect()
+        session.enable_hyperspace()
+        got = q(df2).collect()
+        assert got.num_rows == base.num_rows == 1  # no duplicated rows
+
+    def test_bloom_numeric_in(self, session, hs, ranged_parquet):
+        df = session.read.parquet(ranged_parquet)
+        hs.create_index(
+            df,
+            DataSkippingIndexConfig("dsb", BloomFilterSketch("clicks", 0.01, 1000)),
+        )
+        session.enable_hyperspace()
+        files = scanned_files(
+            session,
+            df.filter(df["clicks"].isin(50, 1050)).select("clicks").logical_plan,
+        )
+        assert len(files) == 2
+
+
+class TestPartitionSketch:
+    def test_constant_column_pruning(self, session, hs, ranged_parquet):
+        df = session.read.parquet(ranged_parquet)
+        hs.create_index(
+            df, DataSkippingIndexConfig("dsp", PartitionSketch("part"))
+        )
+        session.enable_hyperspace()
+        q = lambda d: d.filter(d["part"] == "p1").select("clicks", "part")
+        files = scanned_files(session, q(df).logical_plan)
+        assert len(files) == 1 and "f1.parquet" in files[0]
+        session.disable_hyperspace()
+        base = q(df).collect()
+        session.enable_hyperspace()
+        assert sorted_table(q(df).collect()).equals(sorted_table(base))
+
+
+class TestDataSkippingLifecycle:
+    def test_covering_index_outranks_dataskipping(
+        self, session, hs, ranged_parquet
+    ):
+        from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+
+        df = session.read.parquet(ranged_parquet)
+        hs.create_index(
+            df, DataSkippingIndexConfig("ds", MinMaxSketch("clicks"))
+        )
+        hs.create_index(df, CoveringIndexConfig("ci", ["clicks"], ["name"]))
+        session.enable_hyperspace()
+        plan = df.filter(df["clicks"] == 5).select("clicks", "name").explain()
+        assert "Type: CI" in plan and "Type: DS" not in plan
+
+    def test_refresh_incremental_append_and_delete(
+        self, session, hs, ranged_parquet
+    ):
+        df = session.read.parquet(ranged_parquet)
+        hs.create_index(
+            df, DataSkippingIndexConfig("ds", MinMaxSketch("clicks"))
+        )
+        os.remove(os.path.join(ranged_parquet, "f0.parquet"))
+        pq.write_table(
+            pa.table(
+                {
+                    "clicks": pa.array(range(9000, 9100), type=pa.int64()),
+                    "name": ["file9"] * 100,
+                    "part": ["p9"] * 100,
+                }
+            ),
+            os.path.join(ranged_parquet, "f9.parquet"),
+        )
+        hs.refresh_index("ds", "incremental")
+        session.enable_hyperspace()
+        session.index_manager.clear_cache()
+        df2 = session.read.parquet(ranged_parquet)
+        q = lambda d: d.filter(d["clicks"] == 9050).select("clicks", "name")
+        files = scanned_files(session, q(df2).logical_plan)
+        assert len(files) == 1 and "f9.parquet" in files[0]
+        session.disable_hyperspace()
+        base = q(df2).collect()
+        session.enable_hyperspace()
+        assert sorted_table(q(df2).collect()).equals(sorted_table(base))
+
+    def test_sketch_roundtrip_serialization(self, session, hs, ranged_parquet):
+        df = session.read.parquet(ranged_parquet)
+        hs.create_index(
+            df,
+            DataSkippingIndexConfig(
+                "ds",
+                MinMaxSketch("clicks"),
+                BloomFilterSketch("name", 0.05, 500),
+            ),
+        )
+        session.index_manager.clear_cache()
+        entry = session.index_manager.get_index_log_entry("ds")
+        kinds = {s.kind for s in entry.derived_dataset.sketches}
+        assert kinds == {"MinMaxSketch", "BloomFilterSketch"}
