@@ -89,10 +89,11 @@ class CreateAction(Action):
             C.LINEAGE_PROPERTY: str(self.session.conf.lineage_enabled).lower(),
         }
         leaf = self.df.logical_plan.collect_leaves()[0]
-        if leaf.relation.fmt == "parquet":
+        if leaf.relation.fmt in ("parquet", "delta", "iceberg"):
             props[C.HAS_PARQUET_AS_SOURCE_FORMAT_PROPERTY] = "true"
         rel = self._sources.get_relation(leaf.relation)
-        return rel.enrich_index_properties(props)
+        # final entry commits at base_id + 2 (Action id arithmetic)
+        return rel.enrich_index_properties(props, self.base_id + 2)
 
     # -- log entry (CreateActionBase.getIndexLogEntry:41-83) ----------------
     def begin_log_entry(self) -> IndexLogEntry:

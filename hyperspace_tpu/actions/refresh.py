@@ -139,6 +139,11 @@ class RefreshActionBase(Action):
     # -- log entry construction ---------------------------------------------
     def _build_entry(self, index, content: Content) -> IndexLogEntry:
         source_rel = self.source_relation()
+        # provider bookkeeping moves forward with each refresh (e.g. the
+        # Delta indexLogVersion:deltaVersion history)
+        index.properties = source_rel.enrich_index_properties(
+            index.properties, self.base_id + 2
+        )
         meta_relation = source_rel.create_metadata_relation(self.tracker)
         current_plan = Scan(source_rel.plan_relation)
         fingerprint = IndexSignatureProvider(

@@ -123,8 +123,12 @@ def create_covering_index(ctx, source_df, config, properties: Dict[str, str]):
     )
     file_ids = None
     if lineage:
+        # Key file ids by the PROVIDER's (path,size,mtime) view — the same
+        # keys create_metadata_relation records — or lineage ids and the
+        # log entry's ids diverge for lake sources (Delta mtimes come from
+        # the log, Iceberg pins mtime=0).
         file_ids = {}
-        for path, size, mtime in _stat_files(rel.files):
+        for path, size, mtime in source_file_infos(ctx.session, rel):
             file_ids[path] = ctx.file_id_tracker.add_file(path, size, mtime)
     batch = _scan_with_lineage(rel.files, rel.fmt, indexed + included, file_ids)
     index = CoveringIndex(
@@ -137,11 +141,16 @@ def create_covering_index(ctx, source_df, config, properties: Dict[str, str]):
     return index, batch
 
 
-def _stat_files(files) -> List[Tuple[str, int, int]]:
-    import os
-
+def source_file_infos(session, plan_relation) -> List[Tuple[str, int, int]]:
+    """(path, size, mtime) via the source provider SPI — restricted to the
+    plan relation's current file subset (refresh passes appended-only
+    relations)."""
+    provider_rel = session.source_manager.get_relation(plan_relation)
+    subset = set(plan_relation.files)
     return [
-        (f, os.stat(f).st_size, int(os.stat(f).st_mtime * 1000)) for f in files
+        (p, size, mtime)
+        for p, size, mtime in provider_rel.all_file_infos()
+        if p in subset
     ]
 
 

@@ -90,19 +90,21 @@ class DataSkippingIndex(Index):
         )
 
     # -- build --------------------------------------------------------------
-    def build_sketch_rows(self, ctx, files: List[str], fmt: str) -> pa.Table:
-        """One sketch row per source file (createIndexData:291-317)."""
-        import os
+    def build_sketch_rows(self, ctx, plan_relation) -> pa.Table:
+        """One sketch row per source file (createIndexData:291-317). File
+        ids are keyed by the provider's (path,size,mtime) view so they
+        match the ids recorded in the log entry's source content."""
+        from hyperspace_tpu.indexes.covering_build import source_file_infos
 
+        fmt = plan_relation.fmt
         cols = self.indexed_columns
         fields: List[Tuple[str, pa.DataType]] = [(DATA_FILE_NAME_ID, pa.int64())]
         rows: List[Dict] = []
         out_fields = None
-        for f in sorted(files):
-            st = os.stat(f)
-            fid = ctx.file_id_tracker.add_file(
-                f, st.st_size, int(st.st_mtime * 1000)
-            )
+        for f, size, mtime in sorted(
+            source_file_infos(ctx.session, plan_relation)
+        ):
+            fid = ctx.file_id_tracker.add_file(f, size, mtime)
             batch = ColumnarBatch.from_arrow(pio.read_table([f], cols, fmt))
             row = {DATA_FILE_NAME_ID: fid}
             if out_fields is None:
@@ -141,7 +143,7 @@ class DataSkippingIndex(Index):
         parts = []
         if appended_df is not None:
             rel = appended_df.logical_plan.collect_leaves()[0].relation
-            parts.append(self.build_sketch_rows(ctx, list(rel.files), rel.fmt))
+            parts.append(self.build_sketch_rows(ctx, rel))
         if deleted_source_file_ids:
             old = pio.read_table(list(previous_content.files), None)
             ids = np.asarray(old.column(DATA_FILE_NAME_ID))
@@ -156,7 +158,7 @@ class DataSkippingIndex(Index):
 
     def refresh_full(self, ctx, df) -> "DataSkippingIndex":
         rel = df.logical_plan.collect_leaves()[0].relation
-        table = self.build_sketch_rows(ctx, list(rel.files), rel.fmt)
+        table = self.build_sketch_rows(ctx, rel)
         self.write(ctx, table)
         return self
 
@@ -244,7 +246,7 @@ class DataSkippingIndexConfig(IndexConfigTrait):
     def create_index(self, ctx, source_data, properties: Dict[str, str]):
         index = self._mk_index(ctx, source_data, properties)
         rel = source_data.logical_plan.collect_leaves()[0].relation
-        data = index.build_sketch_rows(ctx, list(rel.files), rel.fmt)
+        data = index.build_sketch_rows(ctx, rel)
         return index, data
 
     def describe_index(self, ctx, source_data, properties: Dict[str, str]):

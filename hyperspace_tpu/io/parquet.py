@@ -34,7 +34,7 @@ def read_table(
     """Read and concatenate files into one Arrow table."""
     tables = []
     for p in paths:
-        if fmt == "parquet":
+        if fmt in ("parquet", "delta", "iceberg"):  # lake data files ARE parquet
             tables.append(pq.read_table(p, columns=list(columns) if columns else None))
         elif fmt == "csv":
             t = pacsv.read_csv(p)

@@ -1,0 +1,47 @@
+"""Relation-aware file scanning: one place that knows how to turn a plan
+Relation's files into an Arrow table.
+
+Handles hive-partitioned lake sources (partition column values live in the
+source metadata — Delta's ``add.partitionValues`` — not in the data files)
+by injecting per-file constants, the role Spark's
+``PartitioningAwareFileIndex`` plays for the reference.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import pyarrow as pa
+
+from hyperspace_tpu.io import parquet as pio
+
+
+def read_relation_files(
+    relation, files: Sequence[str], columns: Optional[Sequence[str]]
+) -> pa.Table:
+    """Read ``files`` of ``relation`` projecting ``columns`` (None = all),
+    injecting partition-value constants where the relation carries them."""
+    pv = dict(relation.file_partition_values)
+    want = list(columns) if columns is not None else relation.column_names
+    if not pv:
+        return pio.read_table(list(files), want, relation.fmt)
+    schema = relation.schema
+    tables = []
+    for f in files:
+        vals = dict(pv.get(f, ()))
+        data_cols = [c for c in want if c not in vals]
+        part_cols = [c for c in want if c in vals]
+        if data_cols:
+            t = pio.read_table([f], data_cols, relation.fmt)
+            n = t.num_rows
+        else:
+            # only partition columns requested: still need the row count
+            t = pio.read_table([f], None, relation.fmt)
+            n = t.num_rows
+            t = t.select([])
+        for c in part_cols:
+            v = vals[c]
+            arr = pa.array([v] * n, type=pa.string()).cast(schema[c])
+            t = t.append_column(c, arr)
+        tables.append(t.select(want))
+    return pa.concat_tables(tables, promote_options="permissive")

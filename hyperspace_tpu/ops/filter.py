@@ -135,11 +135,7 @@ class _Prep:
                 arr = np.array(sorted(ranks) or [-1], dtype=np.int64)
             else:
                 # type-compatible literals only (host path does the same)
-                lits = [
-                    v
-                    for v in vals
-                    if isinstance(v, (int, float)) and not isinstance(v, bool)
-                ]
+                lits = [v for v in vals if isinstance(v, (int, float, bool))]
                 if not lits:
                     return ("const", False)
                 arr = np.sort(np.array(lits))

@@ -421,12 +421,8 @@ def evaluate(expr: Expr, batch) -> Tuple[np.ndarray, Optional[np.ndarray]]:
             return vals, vref.valid
         # keep only type-compatible literals: 5 matches isin(5, "a") on an
         # int column; the string literal can never match and must not
-        # poison the comparison dtype
-        lits = [
-            v
-            for v in expr.values
-            if isinstance(v, (int, float)) and not isinstance(v, bool)
-        ]
+        # poison the comparison dtype (bool counts as numeric: flag.isin(True))
+        lits = [v for v in expr.values if isinstance(v, (int, float, bool))]
         if not lits:
             return np.zeros(n, bool), valid
         vals = np.isin(vref, np.array(lits))

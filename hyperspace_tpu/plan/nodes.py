@@ -87,6 +87,10 @@ class Relation:
     # lineage ids to exclude, None if not needed
     excluded_file_ids: Optional[Tuple[int, ...]] = None
     bucket_spec: Optional[Tuple[int, Tuple[str, ...]]] = None  # (numBuckets, cols)
+    # hive-style partitioned sources (e.g. partitioned Delta): per file, the
+    # partition column values that are NOT stored in the data file and must
+    # be injected as constants at scan time: (path, ((col, str_value),...))
+    file_partition_values: Tuple[Tuple[str, Tuple[Tuple[str, Optional[str]], ...]], ...] = ()
 
     @property
     def schema(self) -> Dict[str, pa.DataType]:

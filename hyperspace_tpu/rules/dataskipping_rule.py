@@ -27,6 +27,17 @@ from hyperspace_tpu.rules.base import CandidateMap, HyperspaceRule, tag_filter_r
 from hyperspace_tpu.rules.filter_rule import _match
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=32)
+def _load_sketch_table(files: tuple):
+    """Sketch tables are immutable per log-entry content (new versions get
+    new file paths), so cache the parquet read across queries — the rule
+    runs inside every optimizer pass."""
+    return pio.read_table(list(files), None)
+
+
 class ApplyDataSkippingIndex(HyperspaceRule):
     name = "ApplyDataSkippingIndex"
     base_score = 1
@@ -72,7 +83,7 @@ class ApplyDataSkippingIndex(HyperspaceRule):
         index = entry.derived_dataset
         if not entry.content.files:
             return None
-        sketch_table = pio.read_table(list(entry.content.files), None)
+        sketch_table = _load_sketch_table(tuple(entry.content.files))
         mask = index.translate_filter(filt.condition, sketch_table)
         if mask is None:
             tag_filter_reason(

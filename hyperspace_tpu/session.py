@@ -67,6 +67,43 @@ class DataFrameReader:
     def json(self, *paths: str) -> DataFrame:
         return self._scan("json", paths)
 
+    def delta(self, path: str, version_as_of: Optional[int] = None) -> DataFrame:
+        """Read a Delta Lake table (optionally pinned to a version — the
+        reference records ``versionAsOf`` for time travel,
+        DeltaLakeRelation.scala:96-99)."""
+        from hyperspace_tpu.sources import delta_log
+
+        snap = delta_log.read_snapshot(path, version_as_of)
+        options = [("deltaVersion", str(snap.version))]
+        if version_as_of is not None:
+            options.append(("versionAsOf", str(version_as_of)))
+        rel = Relation(
+            root_paths=(os.path.abspath(path),),
+            files=tuple(snap.file_paths),
+            fmt="delta",
+            schema_fields=tuple(snap.schema_fields),
+            options=tuple(options),
+        )
+        return DataFrame(self._session, Scan(rel))
+
+    def iceberg(self, path: str, snapshot_id: Optional[int] = None) -> DataFrame:
+        """Read an Iceberg table (optionally pinned to a snapshot — the
+        reference pins scans to snapshot ids, IcebergRelation.scala:222-223)."""
+        from hyperspace_tpu.sources import iceberg_meta
+
+        snap = iceberg_meta.read_snapshot(path, snapshot_id)
+        options = [("snapshotId", str(snap.snapshot_id))]
+        if snapshot_id is not None:
+            options.append(("snapshotAsOf", str(snapshot_id)))
+        rel = Relation(
+            root_paths=(os.path.abspath(path),),
+            files=tuple(snap.file_paths),
+            fmt="iceberg",
+            schema_fields=tuple(snap.schema_fields),
+            options=tuple(options),
+        )
+        return DataFrame(self._session, Scan(rel))
+
 
 class HyperspaceSession:
     def __init__(self, devices: Optional[Sequence] = None):

@@ -1,0 +1,185 @@
+"""Delta Lake transaction log reader (no Spark, no delta-rs).
+
+Reads the ``_delta_log/`` protocol directly: numbered JSON commits with
+``add``/``remove``/``metaData`` actions, plus parquet checkpoints pointed
+at by ``_last_checkpoint``. Snapshot reconstruction = latest checkpoint ≤
+target version, then replay JSON commits. This replaces the reference's
+dependency on the Delta Lake Spark library
+(``sources/delta/DeltaLakeShims``); the log format itself is an open spec.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import urllib.parse
+from typing import Dict, List, Optional, Tuple
+
+import pyarrow as pa
+
+from hyperspace_tpu.exceptions import HyperspaceException
+
+DELTA_LOG_DIR = "_delta_log"
+
+_SPARK_TO_ARROW = {
+    "string": pa.string(),
+    "long": pa.int64(),
+    "integer": pa.int32(),
+    "short": pa.int16(),
+    "byte": pa.int8(),
+    "float": pa.float32(),
+    "double": pa.float64(),
+    "boolean": pa.bool_(),
+    "binary": pa.binary(),
+    "date": pa.date32(),
+    "timestamp": pa.timestamp("us"),
+}
+
+
+def spark_type_to_arrow(t) -> pa.DataType:
+    if isinstance(t, str):
+        if t in _SPARK_TO_ARROW:
+            return _SPARK_TO_ARROW[t]
+        if t.startswith("decimal(") and t.endswith(")"):
+            p, s = t[len("decimal(") : -1].split(",")
+            return pa.decimal128(int(p), int(s))
+    raise HyperspaceException(f"Unsupported Delta type: {t!r}")
+
+
+def parse_schema_string(schema_string: str) -> List[Tuple[str, pa.DataType]]:
+    """Spark StructType JSON -> [(name, arrow type)]."""
+    doc = json.loads(schema_string)
+    return [
+        (f["name"], spark_type_to_arrow(f["type"])) for f in doc.get("fields", [])
+    ]
+
+
+@dataclasses.dataclass
+class DeltaSnapshot:
+    table_path: str
+    version: int
+    # path -> (size, modification_time_ms)
+    files: Dict[str, Tuple[int, int]]
+    schema_fields: List[Tuple[str, pa.DataType]]
+    partition_columns: List[str]
+
+    @property
+    def file_paths(self) -> List[str]:
+        return sorted(self.files)
+
+
+def _log_dir(table_path: str) -> str:
+    return os.path.join(table_path, DELTA_LOG_DIR)
+
+
+def is_delta_table(path: str) -> bool:
+    return os.path.isdir(_log_dir(path))
+
+
+def _commit_versions(log_dir: str) -> List[int]:
+    out = []
+    for name in os.listdir(log_dir):
+        stem, ext = os.path.splitext(name)
+        if ext == ".json" and stem.isdigit():
+            out.append(int(stem))
+    return sorted(out)
+
+
+def _checkpoint_versions(log_dir: str) -> List[int]:
+    out = []
+    for name in os.listdir(log_dir):
+        if name.endswith(".checkpoint.parquet"):
+            stem = name.split(".", 1)[0]
+            if stem.isdigit():
+                out.append(int(stem))
+    return sorted(out)
+
+
+def latest_version(table_path: str) -> int:
+    log_dir = _log_dir(table_path)
+    versions = _commit_versions(log_dir) + _checkpoint_versions(log_dir)
+    if not versions:
+        raise HyperspaceException(f"Not a Delta table (empty log): {table_path}")
+    return max(versions)
+
+
+def _abs_data_path(table_path: str, rel: str) -> str:
+    rel = urllib.parse.unquote(rel)
+    if rel.startswith("file:"):
+        # Hadoop renders local URIs as file:/x, file:///x, or file://host/x
+        import re as _re
+
+        return _re.sub(r"^file:/+", "/", rel)
+    if rel.startswith("/") or "://" in rel:
+        return rel
+    return os.path.join(table_path, rel)
+
+
+def _apply_action(state: dict, action: dict, table_path: str) -> None:
+    if "add" in action and action["add"]:
+        a = action["add"]
+        p = _abs_data_path(table_path, a["path"])
+        state["files"][p] = (
+            int(a.get("size", 0)),
+            int(a.get("modificationTime", 0)),
+        )
+    elif "remove" in action and action["remove"]:
+        p = _abs_data_path(table_path, action["remove"]["path"])
+        state["files"].pop(p, None)
+    elif "metaData" in action and action["metaData"]:
+        md = action["metaData"]
+        if md.get("schemaString"):
+            state["schema"] = parse_schema_string(md["schemaString"])
+        state["partition_columns"] = list(md.get("partitionColumns", []))
+
+
+def _read_checkpoint(state: dict, log_dir: str, version: int, table_path: str):
+    import pyarrow.parquet as pq
+
+    path = os.path.join(log_dir, f"{version:020d}.checkpoint.parquet")
+    table = pq.read_table(path)
+    for row in table.to_pylist():
+        _apply_action(state, {k: v for k, v in row.items() if v is not None},
+                      table_path)
+
+
+def read_snapshot(table_path: str, version: Optional[int] = None) -> DeltaSnapshot:
+    log_dir = _log_dir(table_path)
+    if not os.path.isdir(log_dir):
+        raise HyperspaceException(f"Not a Delta table: {table_path}")
+    target = latest_version(table_path) if version is None else int(version)
+    commits = [v for v in _commit_versions(log_dir) if v <= target]
+    ckpts = [v for v in _checkpoint_versions(log_dir) if v <= target]
+    state = {"files": {}, "schema": None, "partition_columns": []}
+    start = 0
+    if ckpts:
+        ckpt = max(ckpts)
+        _read_checkpoint(state, log_dir, ckpt, table_path)
+        start = ckpt + 1
+    replay = [v for v in commits if v >= start]
+    expected = list(range(start, target + 1))
+    if replay != expected and not (ckpts and max(ckpts) == target and not replay):
+        missing = sorted(set(expected) - set(replay))
+        if missing:
+            raise HyperspaceException(
+                f"Delta log is missing commits {missing} for version {target} "
+                f"of {table_path}"
+            )
+    for v in replay:
+        with open(os.path.join(log_dir, f"{v:020d}.json")) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    _apply_action(state, json.loads(line), table_path)
+    if state["schema"] is None:
+        raise HyperspaceException(
+            f"Delta log has no metaData action up to version {target}"
+        )
+    return DeltaSnapshot(
+        table_path=os.path.abspath(table_path),
+        version=target,
+        files=state["files"],
+        schema_fields=state["schema"],
+        partition_columns=state["partition_columns"],
+    )

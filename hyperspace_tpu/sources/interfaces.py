@@ -51,16 +51,20 @@ class FileBasedRelation(abc.ABC):
         actions; DeltaLakeRelationMetadata.refresh drops versionAsOf)."""
         return self
 
-    def enrich_index_properties(self, properties: Dict[str, str]) -> Dict[str, str]:
-        """Provider-specific properties recorded on the log entry
-        (DeltaLakeRelationMetadata.enrichIndexProperties:45-58)."""
+    def enrich_index_properties(
+        self, properties: Dict[str, str], log_version: Optional[int] = None
+    ) -> Dict[str, str]:
+        """Provider-specific properties recorded on the index
+        (DeltaLakeRelationMetadata.enrichIndexProperties:45-58).
+        ``log_version`` is the log id the enclosing action will commit."""
         return dict(properties)
 
-    def closest_index(self, candidates: List) -> Optional[object]:
-        """For time-travel sources: the index log entry whose source version
-        is closest to this relation's queried version
-        (DeltaLakeRelation.closestIndex:179-251). Default: latest."""
-        return candidates[-1] if candidates else None
+    def closest_index(self, entry):
+        """For time-travel sources: the historical index log entry whose
+        recorded source version is closest to this relation's queried
+        version (DeltaLakeRelation.closestIndex:179-251). Default: the
+        given (latest) entry."""
+        return entry
 
 
 class FileBasedSourceProvider(abc.ABC):
