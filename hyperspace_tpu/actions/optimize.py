@@ -90,7 +90,8 @@ class OptimizeAction(Action):
             self.index_data_path, self.tracker
         )
         ignored_content = Content.from_leaf_files(
-            [(p, i.size, i.modified_time) for p, i in self._ignored]
+            [(p, i.size, i.modified_time) for p, i in self._ignored],
+            self.tracker,
         )
         entry = self._previous.copy()
         entry.content = new_content.merge(ignored_content)

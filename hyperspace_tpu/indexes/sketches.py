@@ -306,6 +306,14 @@ def _value_rep(v, source_type: Optional[str]):
             if not v.is_integer():
                 return _NO_MATCH
             v = int(v)
+        if t.startswith("uint"):
+            # Column key_rep for uint64 is the int64 bit-view (values >= 2^63
+            # appear negative); the probe must match bit-for-bit.
+            if v < 0 or v >= 1 << 64:
+                return _NO_MATCH
+            return int(np.uint64(v).view(np.int64))
+        if v < -(1 << 63) or v >= 1 << 63:
+            return _NO_MATCH
         return int(v)
     if t in ("float", "double", "halffloat"):
         if isinstance(v, bool) or not isinstance(v, (int, float)):

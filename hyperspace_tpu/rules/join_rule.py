@@ -103,6 +103,14 @@ class JoinIndexRule(HyperspaceRule):
                 session, r_entry, right.scan, use_bucket_spec=True
             )
         )
+        # Restore each side's original schema: the index scan may add columns
+        # (e.g. the lineage column) that must not surface in the Join output
+        # (CoveringIndexRuleUtils filters updatedOutput to the relation's
+        # original attributes).
+        if list(new_left.output) != list(plan.left.output):
+            new_left = Project(plan.left.output, new_left)
+        if list(new_right.output) != list(plan.right.output):
+            new_right = Project(plan.right.output, new_right)
         score = self._score(left.scan, l_entry) + self._score(right.scan, r_entry)
         return Join(new_left, new_right, plan.condition, plan.how), score
 

@@ -1,9 +1,11 @@
 """Delta Lake transaction log reader (no Spark, no delta-rs).
 
 Reads the ``_delta_log/`` protocol directly: numbered JSON commits with
-``add``/``remove``/``metaData`` actions, plus parquet checkpoints pointed
-at by ``_last_checkpoint``. Snapshot reconstruction = latest checkpoint ≤
-target version, then replay JSON commits. This replaces the reference's
+``add``/``remove``/``metaData`` actions, plus parquet checkpoints (classic
+single-part and multi-part) discovered by directory listing. Snapshot
+reconstruction = latest readable checkpoint ≤ target version, then replay
+JSON commits. v2 (uuid-named) checkpoints are detected and rejected with a
+clear error when required. This replaces the reference's
 dependency on the Delta Lake Spark library
 (``sources/delta/DeltaLakeShims``); the log format itself is an open spec.
 """
@@ -86,19 +88,50 @@ def _commit_versions(log_dir: str) -> List[int]:
     return sorted(out)
 
 
-def _checkpoint_versions(log_dir: str) -> List[int]:
-    out = []
+def _checkpoint_groups(log_dir: str) -> Tuple[Dict[int, List[str]], List[int]]:
+    """Discover checkpoints: ``{version: [file names]}`` for readable ones
+    (classic single-part ``NNN.checkpoint.parquet`` and complete multi-part
+    ``NNN.checkpoint.MMM.PPP.parquet`` groups), plus versions that exist only
+    as v2/uuid-named checkpoints we cannot read."""
+    singles: Dict[int, List[str]] = {}
+    multi: Dict[int, Dict[int, Dict[int, str]]] = {}
+    v2_only: List[int] = []
     for name in os.listdir(log_dir):
-        if name.endswith(".checkpoint.parquet"):
-            stem = name.split(".", 1)[0]
-            if stem.isdigit():
-                out.append(int(stem))
-    return sorted(out)
+        parts = name.split(".")
+        if len(parts) < 3 or parts[1] != "checkpoint" or not parts[0].isdigit():
+            continue
+        version = int(parts[0])
+        if len(parts) == 3 and parts[2] == "parquet":
+            singles[version] = [name]
+        elif (
+            len(parts) == 5
+            and parts[4] == "parquet"
+            and parts[2].isdigit()
+            and parts[3].isdigit()
+        ):
+            part, num_parts = int(parts[2]), int(parts[3])
+            multi.setdefault(version, {}).setdefault(num_parts, {})[part] = name
+        elif parts[-1] in ("parquet", "json"):
+            # v2 checkpoint (uuid-named) — recognizable but unreadable here
+            v2_only.append(version)
+    groups = dict(singles)
+    for version, by_n in multi.items():
+        if version in groups:
+            continue
+        for num_parts, names in sorted(by_n.items()):
+            if all(i in names for i in range(1, num_parts + 1)):
+                groups[version] = [names[i] for i in range(1, num_parts + 1)]
+                break
+    v2_only = sorted(v for v in set(v2_only) if v not in groups)
+    return groups, v2_only
 
 
 def latest_version(table_path: str) -> int:
     log_dir = _log_dir(table_path)
-    versions = _commit_versions(log_dir) + _checkpoint_versions(log_dir)
+    groups, v2_only = _checkpoint_groups(log_dir)
+    # v2-only checkpoint versions count as existing state (read_snapshot will
+    # then fail with the clear v2-unsupported error rather than "empty log").
+    versions = _commit_versions(log_dir) + sorted(groups) + v2_only
     if not versions:
         raise HyperspaceException(f"Not a Delta table (empty log): {table_path}")
     return max(versions)
@@ -134,14 +167,17 @@ def _apply_action(state: dict, action: dict, table_path: str) -> None:
         state["partition_columns"] = list(md.get("partitionColumns", []))
 
 
-def _read_checkpoint(state: dict, log_dir: str, version: int, table_path: str):
+def _read_checkpoint(
+    state: dict, log_dir: str, names: List[str], table_path: str
+):
     import pyarrow.parquet as pq
 
-    path = os.path.join(log_dir, f"{version:020d}.checkpoint.parquet")
-    table = pq.read_table(path)
-    for row in table.to_pylist():
-        _apply_action(state, {k: v for k, v in row.items() if v is not None},
-                      table_path)
+    for name in names:
+        table = pq.read_table(os.path.join(log_dir, name))
+        for row in table.to_pylist():
+            _apply_action(
+                state, {k: v for k, v in row.items() if v is not None}, table_path
+            )
 
 
 def read_snapshot(table_path: str, version: Optional[int] = None) -> DeltaSnapshot:
@@ -150,18 +186,28 @@ def read_snapshot(table_path: str, version: Optional[int] = None) -> DeltaSnapsh
         raise HyperspaceException(f"Not a Delta table: {table_path}")
     target = latest_version(table_path) if version is None else int(version)
     commits = [v for v in _commit_versions(log_dir) if v <= target]
-    ckpts = [v for v in _checkpoint_versions(log_dir) if v <= target]
+    groups, v2_only = _checkpoint_groups(log_dir)
+    ckpts = [v for v in groups if v <= target]
     state = {"files": {}, "schema": None, "partition_columns": []}
     start = 0
     if ckpts:
+        # Any complete checkpoint <= target is state-equivalent; the newest
+        # one minimizes replay and tolerates stale `_last_checkpoint` hints.
         ckpt = max(ckpts)
-        _read_checkpoint(state, log_dir, ckpt, table_path)
+        _read_checkpoint(state, log_dir, groups[ckpt], table_path)
         start = ckpt + 1
     replay = [v for v in commits if v >= start]
     expected = list(range(start, target + 1))
     if replay != expected and not (ckpts and max(ckpts) == target and not replay):
         missing = sorted(set(expected) - set(replay))
         if missing:
+            newer_v2 = [v for v in v2_only if start <= v <= target]
+            if newer_v2:
+                raise HyperspaceException(
+                    f"Delta log of {table_path} requires v2 (uuid-named) "
+                    f"checkpoint at version {max(newer_v2)}, which is not "
+                    "supported"
+                )
             raise HyperspaceException(
                 f"Delta log is missing commits {missing} for version {target} "
                 f"of {table_path}"

@@ -146,6 +146,17 @@ def read_snapshot(
     if "manifest-list" in snap:  # format v2 (and v1 with manifest lists)
         mlist_path = _resolve_path(table_path, location, snap["manifest-list"])
         for entry in read_avro(mlist_path):
+            # v2 manifest-list entries carry `content`: 0 = data manifest,
+            # 1 = delete manifest (position/equality deletes, merge-on-read).
+            # Row-level delete application is not implemented, so a snapshot
+            # with delete manifests cannot be scanned correctly — refuse it
+            # rather than silently reading delete files as data parquet.
+            if int(entry.get("content") or 0) != 0:
+                raise HyperspaceException(
+                    f"Iceberg snapshot {snapshot_id} of {table_path} contains "
+                    "delete manifests (merge-on-read); row-level deletes are "
+                    "not supported"
+                )
             manifests.append(
                 _resolve_path(table_path, location, entry["manifest_path"])
             )
@@ -159,6 +170,12 @@ def read_snapshot(
             if status == 2:  # DELETED
                 continue
             df = entry.get("data_file") or {}
+            # data_file.content (v2): 0 = data, 1/2 = position/equality deletes
+            if int(df.get("content") or 0) != 0:
+                raise HyperspaceException(
+                    f"Iceberg snapshot {snapshot_id} of {table_path} contains "
+                    "row-level delete files; merge-on-read is not supported"
+                )
             p = _resolve_path(table_path, location, df["file_path"])
             files[p] = (int(df.get("file_size_in_bytes", 0)), 0)
     return IcebergSnapshot(

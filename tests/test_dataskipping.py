@@ -279,3 +279,21 @@ class TestDataSkippingLifecycle:
         entry = session.index_manager.get_index_log_entry("ds")
         kinds = {s.kind for s in entry.derived_dataset.sketches}
         assert kinds == {"MinMaxSketch", "BloomFilterSketch"}
+
+
+class TestValueRepUint64:
+    def test_uint64_probe_matches_bit_view(self):
+        """uint64 literals >= 2^63 must probe with the int64 bit-view that
+        io/columnar assigns as the column key_rep (advisor round-1 low)."""
+        import numpy as np
+
+        from hyperspace_tpu.indexes.sketches import _NO_MATCH, _value_rep
+
+        v = (1 << 63) + 12345
+        rep = _value_rep(v, "uint64")
+        assert rep == int(np.uint64(v).view(np.int64))
+        assert rep < 0  # bit-view wraps negative; np.array([rep]) can't overflow
+        assert _value_rep(1 << 64, "uint64") is _NO_MATCH
+        assert _value_rep(-1, "uint64") is _NO_MATCH
+        assert _value_rep((1 << 63) + 12345, "int64") is _NO_MATCH
+        assert _value_rep(42, "uint32") == 42

@@ -184,3 +184,32 @@ class TestJoinIndexRule:
             zip(q.collect().column("va").to_pylist(), q.collect().column("vb").to_pylist())
         )
         assert pairs == [(1, 10), (1, 20), (3, 40), (4, 10), (4, 20)]
+
+    def test_join_with_lineage_does_not_leak_lineage_column(
+        self, session, hs, join_tables
+    ):
+        """A lineage-enabled index replacing a bare-Scan join side must not
+        surface _data_file_id in the join output (advisor round-1 high;
+        reference CoveringIndexRuleUtils filters updatedOutput to the
+        original relation attributes)."""
+        d1, d2 = join_tables
+        session.conf.set(C.INDEX_LINEAGE_ENABLED, True)
+        dfo = session.read.parquet(d1)
+        dfi = session.read.parquet(d2)
+        hs.create_index(
+            dfo, CoveringIndexConfig("o_idx", ["o_key"], ["o_amount", "o_tag"])
+        )
+        hs.create_index(dfi, CoveringIndexConfig("l_idx", ["l_key"], ["l_qty"]))
+        session.enable_hyperspace()
+        # no select(): each side is a bare Scan, all columns used
+        q = dfo.join(dfi, on=dfo["o_key"] == dfi["l_key"])
+        plan = q.explain()
+        assert plan.count("Hyperspace(Type: CI") == 2, plan
+        got = q.collect()
+        assert C.DATA_FILE_NAME_ID not in got.column_names
+        assert set(got.column_names) == {
+            "o_key", "o_amount", "o_tag", "l_key", "l_qty"
+        }
+        session.disable_hyperspace()
+        base = q.collect()
+        assert sorted_table(got).equals(sorted_table(base))

@@ -381,3 +381,170 @@ class TestIceberg:
         session.index_manager.clear_cache()
         df3 = session.read.iceberg(b.path)
         assert "Hyperspace" in df3.filter(df3["k"] > 0).select("k", "v").explain()
+
+
+class TestDeltaCheckpointFormats:
+    def _checkpoint_rows(self, b):
+        from hyperspace_tpu.sources import delta_log
+
+        snap = delta_log.read_snapshot(b.path)
+        rows = [
+            {
+                "metaData": {"schemaString": DELTA_SCHEMA, "partitionColumns": []},
+                "add": None,
+            }
+        ]
+        for p, (size, mtime) in snap.files.items():
+            rows.append(
+                {
+                    "metaData": None,
+                    "add": {
+                        "path": os.path.relpath(p, b.path),
+                        "size": size,
+                        "modificationTime": mtime,
+                    },
+                }
+            )
+        return rows, snap.version
+
+    def test_multipart_checkpoint(self, tmp_path):
+        from hyperspace_tpu.sources import delta_log
+
+        b = (
+            DeltaBuilder(tmp_path / "t")
+            .init()
+            .append("part-1.parquet", 100)
+            .append("part-2.parquet", 200)
+        )
+        rows, v = self._checkpoint_rows(b)
+        log_dir = os.path.join(b.path, "_delta_log")
+        # split the checkpoint into 2 parts: NNN.checkpoint.MMM.PPP.parquet
+        half = len(rows) // 2
+        for part, chunk in ((1, rows[:half]), (2, rows[half:])):
+            pq.write_table(
+                pa.Table.from_pylist(chunk),
+                os.path.join(
+                    log_dir, f"{v:020d}.checkpoint.{part:010d}.{2:010d}.parquet"
+                ),
+            )
+        with open(os.path.join(log_dir, "_last_checkpoint"), "w") as f:
+            json.dump({"version": v, "size": len(rows), "parts": 2}, f)
+        for j in range(v + 1):
+            os.remove(os.path.join(log_dir, f"{j:020d}.json"))
+        b.append("part-3.parquet", 300)
+        snap = delta_log.read_snapshot(b.path)
+        assert snap.version == v + 1 and len(snap.files) == 4
+
+    def test_incomplete_multipart_checkpoint_ignored(self, tmp_path):
+        from hyperspace_tpu.exceptions import HyperspaceException
+        from hyperspace_tpu.sources import delta_log
+
+        b = DeltaBuilder(tmp_path / "t").init().append("part-1.parquet", 100)
+        rows, v = self._checkpoint_rows(b)
+        log_dir = os.path.join(b.path, "_delta_log")
+        # only part 1 of 2 present -> unusable; must not be picked up
+        pq.write_table(
+            pa.Table.from_pylist(rows),
+            os.path.join(
+                log_dir, f"{v:020d}.checkpoint.{1:010d}.{2:010d}.parquet"
+            ),
+        )
+        snap = delta_log.read_snapshot(b.path)  # replays JSON instead
+        assert len(snap.files) == 2
+        os.remove(os.path.join(log_dir, f"{0:020d}.json"))
+        with pytest.raises(HyperspaceException, match="missing commits"):
+            delta_log.read_snapshot(b.path)
+
+    def test_v2_checkpoint_rejected_clearly(self, tmp_path):
+        from hyperspace_tpu.exceptions import HyperspaceException
+        from hyperspace_tpu.sources import delta_log
+
+        b = DeltaBuilder(tmp_path / "t").init().append("part-1.parquet", 100)
+        rows, v = self._checkpoint_rows(b)
+        log_dir = os.path.join(b.path, "_delta_log")
+        pq.write_table(
+            pa.Table.from_pylist(rows),
+            os.path.join(
+                log_dir,
+                f"{v:020d}.checkpoint.80a083e8-7026-4e79-81be-64bd76c43a11.parquet",
+            ),
+        )
+        for j in range(v + 1):
+            os.remove(os.path.join(log_dir, f"{j:020d}.json"))
+        with pytest.raises(HyperspaceException, match="uuid-named"):
+            delta_log.read_snapshot(b.path)
+
+
+class TestIcebergDeleteManifests:
+    def test_delete_manifest_rejected(self, tmp_path):
+        from hyperspace_tpu.exceptions import HyperspaceException
+        from hyperspace_tpu.sources import iceberg_meta
+        from hyperspace_tpu.utils.avro import write_avro
+
+        b = IcebergBuilder(tmp_path / "t").add_file("f0.parquet", 0).commit()
+        # append a delete manifest (content=1) to the current manifest list
+        sid = len(b.snapshots)
+        mlist = os.path.join(b.path, "metadata", f"snap-{sid}.avro")
+        schema = {
+            "type": "record",
+            "name": "manifest_file",
+            "fields": [
+                {"name": "manifest_path", "type": "string"},
+                {"name": "content", "type": "int"},
+            ],
+        }
+        manifest = os.path.join(b.path, "metadata", f"manifest-{sid}.avro")
+        write_avro(
+            mlist,
+            schema,
+            [
+                {"manifest_path": manifest, "content": 0},
+                {"manifest_path": manifest, "content": 1},
+            ],
+        )
+        with pytest.raises(HyperspaceException, match="delete manifests"):
+            iceberg_meta.read_snapshot(b.path)
+
+    def test_delete_data_file_rejected(self, tmp_path):
+        from hyperspace_tpu.exceptions import HyperspaceException
+        from hyperspace_tpu.sources import iceberg_meta
+        from hyperspace_tpu.utils.avro import write_avro
+
+        b = IcebergBuilder(tmp_path / "t").add_file("f0.parquet", 0).commit()
+        sid = len(b.snapshots)
+        manifest = os.path.join(b.path, "metadata", f"manifest-{sid}.avro")
+        schema = {
+            "type": "record",
+            "name": "manifest_entry",
+            "fields": [
+                {"name": "status", "type": "int"},
+                {
+                    "name": "data_file",
+                    "type": {
+                        "type": "record",
+                        "name": "r2",
+                        "fields": [
+                            {"name": "content", "type": "int"},
+                            {"name": "file_path", "type": "string"},
+                            {"name": "file_size_in_bytes", "type": "long"},
+                        ],
+                    },
+                },
+            ],
+        }
+        write_avro(
+            manifest,
+            schema,
+            [
+                {
+                    "status": 1,
+                    "data_file": {
+                        "content": 2,
+                        "file_path": b.files[0][0],
+                        "file_size_in_bytes": b.files[0][1],
+                    },
+                }
+            ],
+        )
+        with pytest.raises(HyperspaceException, match="row-level delete"):
+            iceberg_meta.read_snapshot(b.path)
