@@ -1,0 +1,131 @@
+"""`hs.explain(df)` — plan diff with vs. without Hyperspace.
+
+Reference: ``plananalysis/PlanAnalyzer.scala:37-418`` — build the plan both
+ways, highlight the subtrees that changed (the index scans), and list the
+indexes used plus, in verbose mode, all ACTIVE candidate indexes and the
+physical-operator-count diff (``PhysicalOperatorAnalyzer.scala``).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, List, Tuple
+
+from hyperspace_tpu.constants import States
+
+_BAR = "=" * 65
+_HL_OPEN = "<----"
+_HL_CLOSE = "---->"
+
+
+def _highlighted_plan(plan, changed_scans) -> str:
+    """Pretty plan string with changed Scan lines wrapped in highlight
+    markers (the reference's BufferStream highlight tags)."""
+    lines = []
+
+    def walk(node, indent):
+        text = "  " * indent + node._node_string()
+        if node in changed_scans:
+            text = f"{_HL_OPEN}{text.lstrip()}{_HL_CLOSE}"
+            text = "  " * indent + text
+        lines.append(text)
+        for c in node.children:
+            walk(c, indent + 1)
+
+    walk(plan, 0)
+    return "\n".join(lines)
+
+
+def _index_scans(plan) -> List:
+    return [s for s in plan.collect_leaves() if s.relation.index_info]
+
+
+def _operator_counts(plan) -> Counter:
+    c: Counter = Counter()
+
+    def walk(node):
+        c[type(node).__name__] += 1
+        for ch in node.children:
+            walk(ch)
+
+    walk(plan)
+    return c
+
+
+def _operator_diff_table(with_plan, without_plan) -> str:
+    """Operator-count comparison (PhysicalOperatorAnalyzer.scala)."""
+    wc, woc = _operator_counts(with_plan), _operator_counts(without_plan)
+    names = sorted(set(wc) | set(woc))
+    rows = [("Operator", "Hyperspace", "Original")]
+    rows += [(n, str(wc.get(n, 0)), str(woc.get(n, 0))) for n in names]
+    widths = [max(len(r[i]) for r in rows) for i in range(3)]
+    out = []
+    for i, r in enumerate(rows):
+        out.append(" | ".join(v.ljust(w) for v, w in zip(r, widths)))
+        if i == 0:
+            out.append("-+-".join("-" * w for w in widths))
+    return "\n".join(out)
+
+
+def explain_string(df, session, manager, verbose: bool = False) -> str:
+    """PlanAnalyzer.explainString: optimize the plan with the rule enabled
+    and render the diff against the unoptimized plan."""
+    original = df.logical_plan
+    prev = session.is_hyperspace_enabled()
+    try:
+        session.enable_hyperspace()
+        optimized = session.optimize(original)
+    finally:
+        if not prev:
+            session.disable_hyperspace()
+
+    used_scans = _index_scans(optimized)
+    used: Dict[str, Tuple[int, str]] = {}
+    for s in used_scans:
+        name, ver, abbr = s.relation.index_info
+        used[name] = (ver, s.relation.root_paths[0] if s.relation.root_paths else "")
+
+    buf = [
+        _BAR,
+        "Plan with indexes:",
+        _BAR,
+        _highlighted_plan(optimized, set(used_scans)),
+        "",
+        _BAR,
+        "Plan without indexes:",
+        _BAR,
+        original.pretty(),
+        "",
+        _BAR,
+        "Indexes used:",
+        _BAR,
+    ]
+    for name in sorted(used):
+        ver, root = used[name]
+        buf.append(f"{name} (v{ver}): {root}")
+    if not used:
+        buf.append("(none)")
+    buf.append("")
+
+    if verbose:
+        buf += [
+            _BAR,
+            "Operator diff:",
+            _BAR,
+            _operator_diff_table(optimized, original),
+            "",
+            _BAR,
+            "Applicable indexes:",
+            _BAR,
+        ]
+        active = manager.get_indexes([States.ACTIVE])
+        for e in sorted(active, key=lambda e: e.name):
+            index = e.derived_dataset
+            buf.append(
+                f"{e.name}: kind={index.kind}, "
+                f"indexed={list(index.indexed_columns)}"
+            )
+        if not active:
+            buf.append("(none)")
+        buf.append("")
+    return "\n".join(buf)

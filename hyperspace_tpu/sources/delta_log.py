@@ -174,6 +174,17 @@ def _read_checkpoint(
 
     for name in names:
         table = pq.read_table(os.path.join(log_dir, name))
+        # The v2 checkpoint spec allows v2 content under classic naming:
+        # data files then live in sidecar files which plain replay would
+        # silently drop — detect and refuse rather than truncate the state.
+        v2_cols = {"checkpointMetadata", "sidecar"} & set(table.column_names)
+        for col in v2_cols:
+            if table.column(col).null_count < table.num_rows:
+                raise HyperspaceException(
+                    f"Delta checkpoint {name} of {table_path} carries v2 "
+                    f"checkpoint actions ({col}); v2 checkpoints are not "
+                    "supported"
+                )
         for row in table.to_pylist():
             _apply_action(
                 state, {k: v for k, v in row.items() if v is not None}, table_path
@@ -202,7 +213,9 @@ def read_snapshot(table_path: str, version: Optional[int] = None) -> DeltaSnapsh
         missing = sorted(set(expected) - set(replay))
         if missing:
             newer_v2 = [v for v in v2_only if start <= v <= target]
-            if newer_v2:
+            # only blame the v2 checkpoint when reading it would actually
+            # cover the gap; otherwise the log is genuinely incomplete
+            if newer_v2 and max(missing) <= max(newer_v2):
                 raise HyperspaceException(
                     f"Delta log of {table_path} requires v2 (uuid-named) "
                     f"checkpoint at version {max(newer_v2)}, which is not "

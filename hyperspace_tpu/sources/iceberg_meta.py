@@ -149,14 +149,22 @@ def read_snapshot(
             # v2 manifest-list entries carry `content`: 0 = data manifest,
             # 1 = delete manifest (position/equality deletes, merge-on-read).
             # Row-level delete application is not implemented, so a snapshot
-            # with delete manifests cannot be scanned correctly — refuse it
+            # with LIVE delete files cannot be scanned correctly — refuse it
             # rather than silently reading delete files as data parquet.
+            # (A delete manifest whose entries are all status=2/removed —
+            # e.g. after compaction applied the deletes — is harmless.)
             if int(entry.get("content") or 0) != 0:
-                raise HyperspaceException(
-                    f"Iceberg snapshot {snapshot_id} of {table_path} contains "
-                    "delete manifests (merge-on-read); row-level deletes are "
-                    "not supported"
-                )
+                dpath = _resolve_path(table_path, location, entry["manifest_path"])
+                live = [
+                    d for d in read_avro(dpath) if d.get("status", 1) != 2
+                ]
+                if live:
+                    raise HyperspaceException(
+                        f"Iceberg snapshot {snapshot_id} of {table_path} "
+                        "contains live delete files (merge-on-read); "
+                        "row-level deletes are not supported"
+                    )
+                continue
             manifests.append(
                 _resolve_path(table_path, location, entry["manifest_path"])
             )

@@ -1,0 +1,130 @@
+"""explain / why_not smoke + behavior tests.
+
+Mirrors ``plananalysis/ExplainTest.scala`` (plan-diff rendering) and the
+``CandidateIndexAnalyzer`` whyNot report: the APIs must return non-trivial
+strings, name the indexes used, and surface recorded FilterReasons.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+
+
+@pytest.fixture
+def hs(session):
+    return Hyperspace(session)
+
+
+@pytest.fixture
+def df(session, sample_parquet):
+    return session.read.parquet(sample_parquet)
+
+
+class TestExplain:
+    def test_explain_shows_used_index_and_diff(self, session, hs, df):
+        hs.create_index(df, CoveringIndexConfig("cl_idx", ["clicks"], ["query"]))
+        q = df.filter(df["clicks"] == 100).select("query")
+        out = hs.explain(q)
+        assert "Plan with indexes:" in out
+        assert "Plan without indexes:" in out
+        assert "Indexes used:" in out
+        assert "cl_idx" in out
+        assert "<----" in out  # changed scan highlighted
+        # with-index section scans the index, without-index scans parquet
+        with_part = out.split("Plan without indexes:")[0]
+        assert "Hyperspace(Type: CI, Name: cl_idx" in with_part
+
+    def test_explain_no_index_used(self, session, hs, df):
+        hs.create_index(df, CoveringIndexConfig("cl_idx", ["clicks"], ["query"]))
+        # predicate on a non-indexed column -> no rewrite
+        q = df.filter(df["imprs"] == 5).select("date")
+        out = hs.explain(q)
+        assert "(none)" in out.split("Indexes used:")[1]
+
+    def test_explain_verbose_operator_diff(self, session, hs, df):
+        hs.create_index(df, CoveringIndexConfig("cl_idx", ["clicks"], ["query"]))
+        q = df.filter(df["clicks"] == 100).select("query")
+        out = hs.explain(q, verbose=True)
+        assert "Operator diff:" in out
+        assert "Applicable indexes:" in out
+        assert "cl_idx: kind=CoveringIndex" in out
+
+    def test_explain_does_not_toggle_session_state(self, session, hs, df):
+        hs.create_index(df, CoveringIndexConfig("cl_idx", ["clicks"], ["query"]))
+        session.disable_hyperspace()
+        hs.explain(df.filter(df["clicks"] == 100).select("query"))
+        assert not session.is_hyperspace_enabled()
+        session.enable_hyperspace()
+        hs.explain(df.filter(df["clicks"] == 100).select("query"))
+        assert session.is_hyperspace_enabled()
+
+
+class TestWhyNot:
+    def test_why_not_reports_reasons(self, session, hs, df):
+        hs.create_index(df, CoveringIndexConfig("cl_idx", ["clicks"], ["query"]))
+        # imprs is not covered -> MISSING_REQUIRED_COL (or no-first-col)
+        q = df.filter(df["clicks"] == 100).select("imprs")
+        out = hs.why_not(q)
+        assert "Non-applicable indexes:" in out
+        assert "cl_idx" in out
+        assert "MISSING_REQUIRED_COL" in out
+
+    def test_why_not_applied_index_listed_applicable(self, session, hs, df):
+        hs.create_index(df, CoveringIndexConfig("cl_idx", ["clicks"], ["query"]))
+        q = df.filter(df["clicks"] == 100).select("query")
+        out = hs.why_not(q)
+        assert "cl_idx: applied" in out
+
+    def test_why_not_first_indexed_col_reason(self, session, hs, df):
+        hs.create_index(
+            df, CoveringIndexConfig("iq_idx", ["imprs", "clicks"], ["query"])
+        )
+        q = df.filter(df["clicks"] == 100).select("query")
+        out = hs.why_not(q, extended=True)
+        assert "NO_FIRST_INDEXED_COL_COND" in out
+        assert "first indexed column" in out  # verbose text in extended mode
+
+    def test_why_not_named_index_filter(self, session, hs, df):
+        hs.create_index(df, CoveringIndexConfig("cl_idx", ["clicks"], ["query"]))
+        q = df.filter(df["clicks"] == 100).select("imprs")
+        out = hs.why_not(q, index_name="cl_idx")
+        assert "cl_idx" in out
+        from hyperspace_tpu.exceptions import HyperspaceException
+
+        with pytest.raises(HyperspaceException, match="No ACTIVE index"):
+            hs.why_not(q, index_name="nope")
+
+    def test_why_not_source_changed_reason(self, session, hs, df, sample_parquet):
+        import os
+
+        hs.create_index(df, CoveringIndexConfig("cl_idx", ["clicks"], ["query"]))
+        # append a new file -> exact-mode signature mismatch
+        t = pa.table(
+            {
+                "date": ["2018-01-01"],
+                "rguid": ["g"],
+                "clicks": pa.array([1], type=pa.int64()),
+                "query": ["zzz"],
+                "imprs": pa.array([2], type=pa.int64()),
+            }
+        )
+        pq.write_table(t, os.path.join(sample_parquet, "extra.parquet"))
+        session.index_manager.clear_cache()
+        df2 = session.read.parquet(sample_parquet)
+        q = df2.filter(df2["clicks"] == 100).select("query")
+        out = hs.why_not(q)
+        assert "SOURCE_DATA_CHANGED" in out
+
+    def test_why_not_reasons_do_not_accumulate(self, session, hs, df):
+        hs.create_index(df, CoveringIndexConfig("cl_idx", ["clicks"], ["query"]))
+        q = df.filter(df["clicks"] == 100).select("imprs")
+        out1 = hs.why_not(q)
+        out2 = hs.why_not(q)
+        assert out1.count("MISSING_REQUIRED_COL") == out2.count(
+            "MISSING_REQUIRED_COL"
+        )

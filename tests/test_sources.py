@@ -502,7 +502,7 @@ class TestIcebergDeleteManifests:
                 {"manifest_path": manifest, "content": 1},
             ],
         )
-        with pytest.raises(HyperspaceException, match="delete manifests"):
+        with pytest.raises(HyperspaceException, match="live delete files"):
             iceberg_meta.read_snapshot(b.path)
 
     def test_delete_data_file_rejected(self, tmp_path):
