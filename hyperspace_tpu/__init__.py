@@ -50,6 +50,7 @@ _LAZY = {
         "hyperspace_tpu.indexes.dataskipping",
         "DataSkippingIndexConfig",
     ),
+    "functions": ("hyperspace_tpu.functions", None),
 }
 
 
@@ -58,7 +59,8 @@ def __getattr__(name):
         import importlib
 
         mod, attr = _LAZY[name]
-        return getattr(importlib.import_module(mod), attr)
+        m = importlib.import_module(mod)
+        return m if attr is None else getattr(m, attr)
     raise AttributeError(f"module 'hyperspace_tpu' has no attribute {name!r}")
 
 

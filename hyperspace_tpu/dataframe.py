@@ -17,7 +17,17 @@ import pyarrow as pa
 
 from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.plan import expressions as E
-from hyperspace_tpu.plan.nodes import Filter, Join, LogicalPlan, Project, Scan
+from hyperspace_tpu.plan.nodes import (
+    Aggregate,
+    AggSpec,
+    Filter,
+    Join,
+    Limit,
+    LogicalPlan,
+    Project,
+    Scan,
+    Sort,
+)
 
 
 class DataFrame:
@@ -73,6 +83,50 @@ class DataFrame:
             )
         return DataFrame(self._session, Join(self._plan, other._plan, on, how))
 
+    def group_by(self, *columns: str) -> "GroupedData":
+        cols = list(
+            columns[0]
+            if len(columns) == 1 and isinstance(columns[0], (list, tuple))
+            else columns
+        )
+        return GroupedData(self._session, self._plan, cols)
+
+    groupBy = group_by
+
+    def agg(self, *aggs: AggSpec) -> "DataFrame":
+        """Global aggregate (no grouping)."""
+        return GroupedData(self._session, self._plan, []).agg(*aggs)
+
+    def sort(self, *keys, ascending: Union[bool, Sequence[bool]] = True) -> "DataFrame":
+        """``sort("a", "b")`` / ``sort(("a", False), "b")`` /
+        ``sort("a", "b", ascending=[False, True])``."""
+        names = list(
+            keys[0]
+            if len(keys) == 1 and isinstance(keys[0], list)
+            else keys
+        )
+        if isinstance(ascending, bool):
+            asc = [ascending] * len(names)
+        else:
+            asc = list(ascending)
+            if len(asc) != len(names):
+                raise HyperspaceException(
+                    "ascending list length must match the number of sort keys"
+                )
+        resolved = []
+        for k, a in zip(names, asc):
+            if isinstance(k, tuple):
+                resolved.append((k[0], bool(k[1])))
+            else:
+                resolved.append((k, a))
+        return DataFrame(self._session, Sort(resolved, self._plan))
+
+    order_by = sort
+    orderBy = sort
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(self._session, Limit(n, self._plan))
+
     # -- actions ------------------------------------------------------------
     def collect(self) -> pa.Table:
         return self._session.execute(self._plan)
@@ -90,3 +144,34 @@ class DataFrame:
 
     def __repr__(self):
         return f"DataFrame[{', '.join(self.columns)}]"
+
+
+class GroupedData:
+    """Result of ``DataFrame.group_by`` — terminal ``agg(...)`` builds the
+    Aggregate node (Spark's ``RelationalGroupedDataset`` shape)."""
+
+    def __init__(self, session, plan: LogicalPlan, group_by: List[str]):
+        self._session = session
+        self._plan = plan
+        self._group_by = group_by
+
+    def agg(self, *aggs: AggSpec) -> DataFrame:
+        specs = list(
+            aggs[0]
+            if len(aggs) == 1 and isinstance(aggs[0], (list, tuple))
+            else aggs
+        )
+        for s in specs:
+            if not isinstance(s, AggSpec):
+                raise HyperspaceException(
+                    f"agg() takes AggSpec values (hyperspace_tpu.functions); "
+                    f"got {s!r}"
+                )
+        return DataFrame(
+            self._session, Aggregate(self._group_by, specs, self._plan)
+        )
+
+    def count(self) -> DataFrame:
+        from hyperspace_tpu import functions as F
+
+        return self.agg(F.count())

@@ -1,0 +1,152 @@
+"""Hash-aggregate execution: host factorize + device segment reductions.
+
+The reference's aggregates run inside Spark's HashAggregateExec; here the
+engine is the serve path. Group ids are computed host-side (one O(rows)
+factorize over the group key reps), then every aggregate is an XLA
+segment reduction (``ops/aggregate.py``) over those ids.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.io.columnar import Column, ColumnarBatch
+from hyperspace_tpu.ops import aggregate as agg_ops
+from hyperspace_tpu.ops.sort import order_rep
+from hyperspace_tpu.plan.nodes import AggSpec, _agg_output_type
+
+
+def _grouping_rep(col: Column) -> np.ndarray:
+    """Per-column int64 rep where equality == SQL group-by equality.
+
+    Strings use dictionary codes (exact within a batch — no hash
+    collisions); numerics use ``key_rep`` (canonicalizes NaN/-0.0 and maps
+    nulls to one sentinel, so they form single groups as SQL requires).
+    """
+    if col.kind == "string":
+        return col.codes.astype(np.int64)
+    return col.key_rep()
+
+
+def _factorize(batch: ColumnarBatch, group_by: List[str]) -> Tuple[np.ndarray, np.ndarray, int]:
+    """-> (group_ids [n], first_occurrence_row_per_group, num_groups)."""
+    n = batch.num_rows
+    if not group_by:
+        return np.zeros(n, dtype=np.int64), np.zeros(0, dtype=np.int64), 1
+    reps = np.stack([_grouping_rep(batch.column(c)) for c in group_by])
+    rows = np.ascontiguousarray(reps.T)
+    voids = rows.view([("", rows.dtype)] * rows.shape[1]).ravel()
+    _, first, gid = np.unique(voids, return_index=True, return_inverse=True)
+    return gid.astype(np.int64), first, len(first)
+
+
+def _valid_mask(col: Column) -> Optional[np.ndarray]:
+    null = col.null_mask
+    return None if null is None else ~null
+
+
+def _numeric_values(col: Column, spec: AggSpec) -> np.ndarray:
+    if col.kind != "numeric":
+        raise HyperspaceException(
+            f"{spec.func}() over non-numeric column {spec.column!r}"
+        )
+    return col.values
+
+
+def _string_minmax(
+    col: Column, gid: np.ndarray, num_groups: int, mode: str
+) -> Column:
+    """min/max over a string column: reduce per-batch dictionary ranks on
+    device, then map winning ranks back to strings."""
+    sorted_dict = sorted(col.dictionary)
+    ranks = order_rep(col)
+    valid = _valid_mask(col)
+    win = agg_ops.segment_minmax(gid, ranks, valid, num_groups, mode)
+    counts = agg_ops.segment_count(gid, valid, len(ranks), num_groups)
+    has = counts > 0
+    codes = np.where(has, np.clip(win, 0, max(len(sorted_dict) - 1, 0)), -1)
+    return Column(
+        "string",
+        col.arrow_type,
+        codes=codes.astype(np.int32),
+        dictionary=sorted_dict,
+    )
+
+
+def execute_aggregate(
+    batch: ColumnarBatch,
+    group_by: List[str],
+    aggs: List[AggSpec],
+    child_schema,
+) -> ColumnarBatch:
+    gid, first, num_groups = _factorize(batch, group_by)
+    n = batch.num_rows
+
+    out = {}
+    if group_by:
+        keys = batch.take(first)
+        for c in group_by:
+            out[c] = keys.column(c)
+
+    for spec in aggs:
+        out_type = _agg_output_type(spec, child_schema)
+        if spec.func == "count":
+            if spec.column is None:
+                counts = agg_ops.segment_count(gid, None, n, num_groups)
+            else:
+                col = batch.column(spec.column)
+                counts = agg_ops.segment_count(
+                    gid, _valid_mask(col), n, num_groups
+                )
+            out[spec.name] = Column("numeric", out_type, values=counts)
+            continue
+
+        col = batch.column(spec.column)
+        if spec.func in ("min", "max"):
+            if col.kind == "string":
+                out[spec.name] = _string_minmax(
+                    col, gid, num_groups, spec.func
+                )
+                continue
+            vals = _numeric_values(col, spec)
+            valid = _valid_mask(col)
+            red = agg_ops.segment_minmax(gid, vals, valid, num_groups, spec.func)
+            counts = agg_ops.segment_count(gid, valid, n, num_groups)
+            has = counts > 0
+            red = red.astype(vals.dtype, copy=False)
+            out[spec.name] = Column(
+                "numeric",
+                out_type,
+                values=np.where(has, red, np.zeros_like(red)),
+                validity=None if has.all() else has,
+            )
+            continue
+
+        # sum / avg
+        vals = _numeric_values(col, spec)
+        valid = _valid_mask(col)
+        sums, counts = agg_ops.segment_sum_count(gid, vals, valid, num_groups)
+        has = counts > 0
+        if spec.func == "sum":
+            target = np.float64 if pa.types.is_floating(out_type) else np.int64
+            sums = sums.astype(target, copy=False)
+            out[spec.name] = Column(
+                "numeric",
+                out_type,
+                values=np.where(has, sums, np.zeros_like(sums)),
+                validity=None if has.all() else has,
+            )
+        else:  # avg
+            with np.errstate(invalid="ignore", divide="ignore"):
+                avg = sums.astype(np.float64) / np.maximum(counts, 1)
+            out[spec.name] = Column(
+                "numeric",
+                out_type,
+                values=np.where(has, avg, 0.0),
+                validity=None if has.all() else has,
+            )
+    return ColumnarBatch(out)

@@ -19,11 +19,14 @@ from hyperspace_tpu.io.columnar import ColumnarBatch
 from hyperspace_tpu.ops.filter import Unsupported, device_filter_mask
 from hyperspace_tpu.plan import expressions as E
 from hyperspace_tpu.plan.nodes import (
+    Aggregate,
     Filter,
     Join,
+    Limit,
     LogicalPlan,
     Project,
     Scan,
+    Sort,
     Union,
 )
 
@@ -51,6 +54,24 @@ def _exec(plan: LogicalPlan, needed: Set[str], session) -> ColumnarBatch:
         return ColumnarBatch.concat([left, right])
     if isinstance(plan, Join):
         return _exec_join(plan, needed, session)
+    if isinstance(plan, Aggregate):
+        batch = _exec(plan.child, plan.input_columns, session)
+        from hyperspace_tpu.execution.aggregate_exec import execute_aggregate
+
+        return execute_aggregate(
+            batch, plan.group_by, plan.aggs, plan.child.schema()
+        )
+    if isinstance(plan, Sort):
+        from hyperspace_tpu.ops.sort import ordering_permutation
+
+        child_needed = set(needed) | {c for c, _ in plan.keys}
+        batch = _exec(plan.child, child_needed, session)
+        if batch.num_rows == 0:
+            return batch
+        return batch.take(ordering_permutation(batch, plan.keys))
+    if isinstance(plan, Limit):
+        batch = _exec(plan.child, needed, session)
+        return batch.take(np.arange(min(plan.n, batch.num_rows)))
     raise HyperspaceException(f"Unknown plan node: {type(plan).__name__}")
 
 

@@ -1,0 +1,119 @@
+"""Device grouped reductions — the engine's aggregate kernel.
+
+The reference delegates group-by to Spark's hash aggregate; here the
+engine is the serve path, so grouped reductions run as XLA segment ops
+(``jax.ops.segment_sum``/``min``/``max``): group ids are computed on host
+(O(rows) factorize over int64 key reps), the O(rows·aggs) reduction work
+runs compiled on device. Null semantics match SQL/Spark: sum/min/max/avg
+ignore nulls (an all-null group yields null), count(col) counts non-null
+rows, count(*) counts rows.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import hyperspace_tpu.ops  # noqa: F401  (enables x64)
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments",))
+def _seg_sum_count(gid, vals, valid, num_segments):
+    """(per-group sum over valid rows, per-group count of valid rows)."""
+    v = jnp.where(valid, vals, jnp.zeros((), dtype=vals.dtype))
+    sums = jax.ops.segment_sum(v, gid, num_segments=num_segments)
+    counts = jax.ops.segment_sum(
+        valid.astype(jnp.int64), gid, num_segments=num_segments
+    )
+    return sums, counts
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments",))
+def _seg_min(gid, vals, valid, num_segments):
+    if jnp.issubdtype(vals.dtype, jnp.floating):
+        # Spark float ordering: NaN > +inf, so min is NaN only when the
+        # group has no non-NaN valid values (matches ops/sort.order_rep).
+        isn = jnp.isnan(vals)
+        clean = jnp.where(valid & ~isn, vals, jnp.inf)
+        m = jax.ops.segment_min(clean, gid, num_segments=num_segments)
+        has_clean = (
+            jax.ops.segment_sum(
+                (valid & ~isn).astype(jnp.int32), gid, num_segments=num_segments
+            )
+            > 0
+        )
+        return jnp.where(has_clean, m, jnp.asarray(jnp.nan, vals.dtype))
+    v = jnp.where(valid, vals, jnp.iinfo(vals.dtype).max)
+    return jax.ops.segment_min(v, gid, num_segments=num_segments)
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments",))
+def _seg_max(gid, vals, valid, num_segments):
+    if jnp.issubdtype(vals.dtype, jnp.floating):
+        # Spark float ordering: any valid NaN wins the max.
+        isn = jnp.isnan(vals)
+        clean = jnp.where(valid & ~isn, vals, -jnp.inf)
+        m = jax.ops.segment_max(clean, gid, num_segments=num_segments)
+        has_nan = (
+            jax.ops.segment_sum(
+                (valid & isn).astype(jnp.int32), gid, num_segments=num_segments
+            )
+            > 0
+        )
+        return jnp.where(has_nan, jnp.asarray(jnp.nan, vals.dtype), m)
+    v = jnp.where(valid, vals, jnp.iinfo(vals.dtype).min)
+    return jax.ops.segment_max(v, gid, num_segments=num_segments)
+
+
+def _as_device(vals: np.ndarray) -> jnp.ndarray:
+    if vals.dtype.kind == "b":
+        return jnp.asarray(vals.astype(np.int64))
+    if vals.dtype.kind == "u":
+        # keep unsigned (x64 enabled): min/max order and modular sums stay
+        # correct; the executor casts back to the output type
+        return jnp.asarray(vals.astype(np.uint64))
+    return jnp.asarray(vals)
+
+
+def segment_sum_count(
+    gid: np.ndarray,
+    vals: np.ndarray,
+    valid: Optional[np.ndarray],
+    num_segments: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    valid = (
+        np.ones(len(vals), dtype=bool) if valid is None else valid
+    )
+    s, c = _seg_sum_count(
+        jnp.asarray(gid), _as_device(vals), jnp.asarray(valid), num_segments
+    )
+    return np.asarray(s), np.asarray(c)
+
+
+def segment_minmax(
+    gid: np.ndarray,
+    vals: np.ndarray,
+    valid: Optional[np.ndarray],
+    num_segments: int,
+    mode: str,
+) -> np.ndarray:
+    valid = np.ones(len(vals), dtype=bool) if valid is None else valid
+    fn = _seg_min if mode == "min" else _seg_max
+    out = fn(jnp.asarray(gid), _as_device(vals), jnp.asarray(valid), num_segments)
+    return np.asarray(out)
+
+
+def segment_count(
+    gid: np.ndarray, valid: Optional[np.ndarray], n: int, num_segments: int
+) -> np.ndarray:
+    valid = np.ones(n, dtype=bool) if valid is None else valid
+    counts = jax.ops.segment_sum(
+        jnp.asarray(valid).astype(jnp.int64),
+        jnp.asarray(gid),
+        num_segments=num_segments,
+    )
+    return np.asarray(counts)

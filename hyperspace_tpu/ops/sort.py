@@ -53,3 +53,66 @@ def sort_permutation(
             [bucket.astype(np.uint32)[None, :], planes]
         )
     return np.asarray(lexsort_indices(jnp.asarray(planes)))
+
+
+# ---------------------------------------------------------------------------
+# User-facing ORDER BY (value order, not key-rep order)
+# ---------------------------------------------------------------------------
+
+
+def order_rep(col) -> np.ndarray:
+    """int64 rep whose signed order equals the column's VALUE order.
+
+    Unlike ``Column.key_rep`` (arbitrary-but-consistent order, hash for
+    strings), this is order-preserving: ints/temporal as-is, uints via
+    sign-bit xor, floats via the IEEE-754 total-order trick (NaN sorts
+    after +inf, matching numpy/pyarrow), strings via per-batch dictionary
+    rank. Null placement is handled by the caller (``ordering_permutation``
+    adds a null plane), so nulls here get an arbitrary in-band value.
+    """
+    if col.kind == "string":
+        order = sorted(range(len(col.dictionary)), key=col.dictionary.__getitem__)
+        rank = np.empty(max(len(col.dictionary), 1), dtype=np.int64)
+        for r, i in enumerate(order):
+            rank[i] = r
+        return rank[np.maximum(col.codes, 0)].astype(np.int64)
+    v = col.values
+    if v.dtype.kind == "f":
+        # IEEE-754 total order as SIGNED int64: positives keep their bit
+        # pattern; negatives complement the magnitude bits (sign bit stays,
+        # so they remain negative and larger magnitudes sort lower).
+        u = v.astype(np.float64).view(np.uint64)
+        rep = np.where(
+            u >> np.uint64(63) == 1,
+            u ^ np.uint64(0x7FFFFFFFFFFFFFFF),
+            u,
+        )
+        return rep.view(np.int64)
+    if v.dtype.kind == "u":
+        return (
+            v.astype(np.uint64) ^ np.uint64(0x8000000000000000)
+        ).view(np.int64)
+    if v.dtype.kind == "b":
+        return v.astype(np.int64)
+    return v.astype(np.int64)
+
+
+def ordering_permutation(batch, keys) -> np.ndarray:
+    """Stable permutation ordering ``batch`` by ``keys`` =
+    ((column, ascending), ...). Nulls always sort last (pyarrow's
+    ``null_placement="at_end"``); descending flips values, not nulls."""
+    planes = []
+    for name, asc in keys:
+        col = batch.column(name)
+        rep = order_rep(col)
+        if not asc:
+            rep = ~rep  # bitwise complement reverses signed order
+        null = col.null_mask
+        null_plane = (
+            np.zeros(len(col), dtype=np.uint32)
+            if null is None
+            else null.astype(np.uint32)
+        )
+        planes.append(null_plane)
+        planes.extend(_order_words_np(rep[None, :]))
+    return np.asarray(lexsort_indices(jnp.asarray(np.stack(planes))))
