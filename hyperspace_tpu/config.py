@@ -120,6 +120,13 @@ class Config:
         return [s.strip() for s in raw.split(",") if s.strip()]
 
     @property
+    def device_join_min_rows(self) -> int:
+        return self.get_int(
+            C.EXECUTION_DEVICE_JOIN_MIN_ROWS,
+            C.EXECUTION_DEVICE_JOIN_MIN_ROWS_DEFAULT,
+        )
+
+    @property
     def default_supported_formats(self) -> set:
         raw = self.get_str(
             C.DEFAULT_SUPPORTED_FORMATS, C.DEFAULT_SUPPORTED_FORMATS_DEFAULT

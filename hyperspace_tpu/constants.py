@@ -137,3 +137,11 @@ NESTED_FIELD_PREFIX = "__hs_nested."
 
 # Filenames written by the index data plane.
 INDEX_FILE_PREFIX = "part"
+
+# -- execution tuning --------------------------------------------------------
+# Minimum total joined rows before the co-bucketed merge join dispatches to
+# the device kernel; below this the host twin of the same algorithm wins
+# because per-dispatch + transfer latency dominates (very pronounced on a
+# tunneled chip; still real on PCIe).
+EXECUTION_DEVICE_JOIN_MIN_ROWS = "hyperspace.execution.deviceJoinMinRows"
+EXECUTION_DEVICE_JOIN_MIN_ROWS_DEFAULT = 2_000_000

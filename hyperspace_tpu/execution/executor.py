@@ -91,22 +91,24 @@ def _exec_join(plan: Join, needed: Set[str], session) -> ColumnarBatch:
     l_needed = (needed & lcols) | {l for l, _ in on}
     rcols = set(plan.right.output)
     r_needed = (needed & rcols) | {r for _, r in on}
-    from hyperspace_tpu.execution.join_exec import inner_join
+    from hyperspace_tpu.execution.join_exec import co_bucketed_join, inner_join
 
     layout = _aligned_bucket_layouts(plan, on)
     if layout is not None:
         # Shuffle-free co-bucketed join (the JoinIndexRule payoff; the
         # physical analogue of Spark SMJ over co-bucketed index scans with
-        # no Exchange, JoinIndexRule.scala:619-634): zip equal buckets.
+        # no Exchange, JoinIndexRule.scala:619-634): the per-bucket merge
+        # runs as one compiled program, buckets sharded across the mesh.
         num_buckets, l_bucket_cols, r_bucket_cols = layout
         lbs = _exec_bucketed(plan.left, l_needed, session, l_bucket_cols)
         rbs = _exec_bucketed(plan.right, r_needed, session, r_bucket_cols)
-        parts = [
-            inner_join(lbs[b], rbs[b], on)
-            for b in sorted(set(lbs) & set(rbs))
-        ]
-        if parts:
-            return ColumnarBatch.concat(parts)
+        mesh = session.runtime.mesh if session is not None else None
+        min_rows = (
+            session.conf.device_join_min_rows if session is not None else 0
+        )
+        joined = co_bucketed_join(lbs, rbs, on, mesh, min_rows)
+        if joined is not None:
+            return joined
         import pyarrow as pa
 
         schema = plan.schema()
@@ -174,13 +176,42 @@ def _exec_bucketed(
     from hyperspace_tpu.ops.hash import bucket_ids_np
 
     if isinstance(plan, Scan):
+        rel = plan.relation
         groups = {}
-        for f in plan.relation.files:
+        for f in rel.files:
             b = bucket_id_of_file(f)
             groups.setdefault(b, []).append(f)
+        fast = (
+            rel.fmt in ("parquet", "delta", "iceberg")
+            and rel.excluded_file_ids is None
+            and not rel.file_partition_values
+            and len(rel.files) > 1
+            and None not in groups
+        )
+        if fast:
+            # one threaded read over every bucket's files, sliced back into
+            # buckets via footer row counts — N small per-bucket reads pay
+            # a per-call cost that dominates serve latency otherwise
+            cols = [c for c in rel.column_names if c in needed] or (
+                rel.column_names[:1]
+            )
+            ordered = [(b, f) for b in sorted(groups) for f in groups[b]]
+            counts = pio.file_row_counts([f for _, f in ordered])
+            table = pio.read_table([f for _, f in ordered], cols, rel.fmt)
+            batch = ColumnarBatch.from_arrow(table)
+            per_bucket = {}
+            for (b, _f), c in zip(ordered, counts):
+                per_bucket[b] = per_bucket.get(b, 0) + c
+            out = {}
+            pos = 0
+            for b in sorted(groups):
+                c = per_bucket[b]
+                out[b] = batch.take(np.arange(pos, pos + c))
+                pos += c
+            return out
         out = {}
         for b, files in groups.items():
-            sub = Scan(dataclasses.replace(plan.relation, files=tuple(files)))
+            sub = Scan(dataclasses.replace(rel, files=tuple(files)))
             out[b] = _exec_scan(sub, needed, session)
         return out
     if isinstance(plan, Filter):

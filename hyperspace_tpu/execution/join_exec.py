@@ -16,7 +16,7 @@ collision, so string key columns are re-verified via dictionary remapping
 
 from __future__ import annotations
 
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -52,26 +52,152 @@ def merge_join_indices(
     return li, ri
 
 
-def _verify_string_keys(
+def _verify_keys(
     left: ColumnarBatch,
     right: ColumnarBatch,
     on: List[Tuple[str, str]],
     li: np.ndarray,
     ri: np.ndarray,
+    l_reps: np.ndarray = None,
+    r_reps: np.ndarray = None,
+    verify_numeric: bool = True,
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Drop rep-collision false positives on string key columns."""
+    """Exact re-verification of every key column at the matched pairs:
+    string columns via dictionary remap (murmur collision guard), numeric
+    columns via rep equality (combine-hash / null-sentinel collision
+    guard). ``l_reps``/``r_reps`` are the per-side [k, n] rep matrices
+    when the caller already computed them; ``verify_numeric=False`` skips
+    the numeric check for callers whose matching was already rep-exact."""
     keep = np.ones(len(li), dtype=bool)
-    for lname, rname in on:
+    for j, (lname, rname) in enumerate(on):
         lc, rc = left.column(lname), right.column(rname)
-        if lc.kind != "string" or rc.kind != "string":
-            continue
-        from hyperspace_tpu.io.columnar import remap_codes
+        if lc.kind == "string" and rc.kind == "string":
+            from hyperspace_tpu.io.columnar import remap_codes
 
-        rcodes = remap_codes(lc.dictionary, rc)
-        keep &= lc.codes[li] == rcodes[ri]
+            rcodes = remap_codes(lc.dictionary, rc)
+            keep &= lc.codes[li] == rcodes[ri]
+        elif verify_numeric:
+            lr = l_reps[j] if l_reps is not None else lc.key_rep()
+            rr = r_reps[j] if r_reps is not None else rc.key_rep()
+            keep &= lr[li] == rr[ri]
     if keep.all():
         return li, ri
     return li[keep], ri[keep]
+
+
+def _assemble(
+    left: ColumnarBatch,
+    right: ColumnarBatch,
+    li: np.ndarray,
+    ri: np.ndarray,
+) -> ColumnarBatch:
+    """Join output contract: left columns then right columns at the pairs."""
+    out = {}
+    for name, col in left.columns.items():
+        out[name] = col.take(li)
+    for name, col in right.columns.items():
+        out[name] = col.take(ri)
+    return ColumnarBatch(out)
+
+
+def co_bucketed_join(
+    lbs: dict,
+    rbs: dict,
+    on: List[Tuple[str, str]],
+    mesh=None,
+    device_min_rows: int = 0,
+) -> Optional[ColumnarBatch]:
+    """Shuffle-free join of co-bucketed per-bucket batches.
+
+    The matching work (argsort + binary-search ranges per bucket) runs as
+    ONE compiled device program vmapped over buckets and sharded over the
+    mesh (``ops/join.py``) — the TPU equivalent of the reference's
+    executor-parallel SMJ over co-bucketed scans
+    (``covering/JoinIndexRule.scala:619-634``). The host expands match
+    ranges (O(matches)) and re-verifies keys exactly.
+
+    Returns the joined batch, or None when the sides share no bucket (the
+    caller builds the schema-correct empty result).
+    """
+    from hyperspace_tpu.io.columnar import NULL_KEY_REP
+    from hyperspace_tpu.ops.join import bucketed_match_ranges, combine_reps_np
+
+    buckets = sorted(set(lbs) & set(rbs))
+    z = np.zeros(0, dtype=np.int64)
+    if not buckets:
+        return None
+    l_all = ColumnarBatch.concat([lbs[b] for b in buckets])
+    r_all = ColumnarBatch.concat([rbs[b] for b in buckets])
+    l_sizes = [lbs[b].num_rows for b in buckets]
+    r_sizes = [rbs[b].num_rows for b in buckets]
+    l_offs = np.concatenate([[0], np.cumsum(l_sizes)[:-1]]).astype(np.int64)
+    r_offs = np.concatenate([[0], np.cumsum(r_sizes)[:-1]]).astype(np.int64)
+
+    def side_arrays(batch, sizes, offs, cols, parity):
+        reps = batch.key_reps(cols)  # kept for exact verification below
+        ok = ~(reps == NULL_KEY_REP).any(axis=0)
+        combined = combine_reps_np(reps)
+        # exclude null keys from matching (SQL: null never equals null):
+        # give each null row a unique sentinel; left uses even offsets and
+        # right odd, so the two sides' sentinels can never collide either
+        bad = np.nonzero(~ok)[0]
+        combined[bad] = (
+            np.int64(-0x4000000000000000) - 2 * np.arange(len(bad)) - parity
+        )
+        n_max = max(sizes) if sizes else 0
+        B = len(sizes)
+        padded = np.full((B, max(n_max, 1)), np.int64(0x7FFFFFFFFFFFFFFF))
+        rowmap = np.zeros((B, max(n_max, 1)), dtype=np.int64)
+        for i, (sz, off) in enumerate(zip(sizes, offs)):
+            padded[i, :sz] = combined[off : off + sz]
+            rowmap[i, :sz] = np.arange(off, off + sz)
+        return padded, np.array(sizes, dtype=np.int64), rowmap, reps
+
+    l_pad, l_len, l_rowmap, l_reps = side_arrays(
+        l_all, l_sizes, l_offs, [l for l, _ in on], 0
+    )
+    r_pad, r_len, r_rowmap, r_reps = side_arrays(
+        r_all, r_sizes, r_offs, [r for _, r in on], 1
+    )
+    # pad the bucket dimension so shard_map divides evenly
+    if mesh is not None and mesh.devices.size > 1:
+        D = mesh.devices.size
+        B = l_pad.shape[0]
+        extra = (-B) % D
+        if extra:
+            def grow(a, fill):
+                pad = np.full((extra,) + a.shape[1:], fill, dtype=a.dtype)
+                return np.concatenate([a, pad])
+
+            l_pad = grow(l_pad, np.int64(0x7FFFFFFFFFFFFFFF))
+            r_pad = grow(r_pad, np.int64(0x7FFFFFFFFFFFFFFF))
+            l_len = grow(l_len, 0)
+            r_len = grow(r_len, 0)
+            l_rowmap = grow(l_rowmap, 0)
+            r_rowmap = grow(r_rowmap, 0)
+    perm_l, perm_r, lo, cnt = bucketed_match_ranges(
+        mesh, l_pad, l_len, r_pad, r_len, device_min_rows
+    )
+    li_parts, ri_parts = [], []
+    for b in range(len(l_len)):
+        total = int(cnt[b].sum())
+        if total == 0:
+            continue
+        c = cnt[b]
+        li_sorted = np.repeat(np.arange(len(c), dtype=np.int64), c)
+        starts = np.concatenate([[0], np.cumsum(c)[:-1]])
+        within = np.arange(total, dtype=np.int64) - np.repeat(starts, c)
+        ri_sorted = lo[b][li_sorted] + within
+        li_parts.append(l_rowmap[b][perm_l[b][li_sorted]])
+        ri_parts.append(r_rowmap[b][perm_r[b][ri_sorted]])
+    if not li_parts:
+        return _assemble(l_all, r_all, z, z)
+    li = np.concatenate(li_parts)
+    ri = np.concatenate(ri_parts)
+    # numeric verification guards combine-hash and null-sentinel
+    # collisions (a real key value can equal another row's sentinel)
+    li, ri = _verify_keys(l_all, r_all, on, li, ri, l_reps, r_reps)
+    return _assemble(l_all, r_all, li, ri)
 
 
 def inner_join(
@@ -91,10 +217,7 @@ def inner_join(
     r_map = np.nonzero(r_ok)[0]
     li, ri = merge_join_indices(l_reps[:, l_ok], r_reps[:, r_ok])
     li, ri = l_map[li], r_map[ri]
-    li, ri = _verify_string_keys(left, right, on, li, ri)
-    out = {}
-    for name, col in left.columns.items():
-        out[name] = col.take(li)
-    for name, col in right.columns.items():
-        out[name] = col.take(ri)
-    return ColumnarBatch(out)
+    # matching was rep-exact (np.unique over full rep rows), so only the
+    # string hash-collision guard is needed
+    li, ri = _verify_keys(left, right, on, li, ri, verify_numeric=False)
+    return _assemble(left, right, li, ri)

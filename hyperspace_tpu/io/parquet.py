@@ -28,10 +28,44 @@ from hyperspace_tpu.io.columnar import ColumnarBatch
 _BUCKET_FILE_RE = re.compile(r"part-\d+-bucket_(\d+)\.parquet$")
 
 
+def _pool_map(fn, items):
+    """Footer-metadata reads through a small thread pool (high-latency
+    storage pays per-call latency N times otherwise)."""
+    if len(items) <= 4:
+        return [fn(x) for x in items]
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=min(16, len(items))) as pool:
+        return list(pool.map(fn, items))
+
+
+def _file_schemas(paths: Sequence[str]) -> List[pa.Schema]:
+    return _pool_map(lambda p: pq.ParquetFile(p).schema_arrow, list(paths))
+
+
+def file_row_counts(paths: Sequence[str]) -> List[int]:
+    """Per-file row counts from parquet footers (threaded)."""
+    return _pool_map(
+        lambda p: pq.ParquetFile(p).metadata.num_rows, list(paths)
+    )
+
+
 def read_table(
     paths: Sequence[str], columns: Optional[Sequence[str]] = None, fmt: str = "parquet"
 ) -> pa.Table:
-    """Read and concatenate files into one Arrow table."""
+    """Read and concatenate files into one Arrow table (row order follows
+    ``paths`` order, file by file)."""
+    if fmt in ("parquet", "delta", "iceberg") and len(paths) > 1:
+        # One threaded dataset read beats N sequential reads ~3x and pyarrow
+        # preserves the given file order — but it locks the first file's
+        # schema, so it is only safe when all schemas match (always true
+        # for index data; source tables can carry type-widening evolution,
+        # which needs the permissive per-file concat below).
+        schemas = _file_schemas(paths)
+        if all(s.equals(schemas[0]) for s in schemas[1:]):
+            return pq.read_table(
+                list(paths), columns=list(columns) if columns else None
+            )
     tables = []
     for p in paths:
         if fmt in ("parquet", "delta", "iceberg"):  # lake data files ARE parquet

@@ -213,3 +213,77 @@ class TestJoinIndexRule:
         session.disable_hyperspace()
         base = q.collect()
         assert sorted_table(got).equals(sorted_table(base))
+
+    def test_multi_key_join_with_nulls_device_path(self, session, hs, tmp_path):
+        """Composite-key co-bucketed join through the device merge kernel,
+        with null keys on both sides (SQL: null never matches)."""
+        # force the device kernel path (default threshold would pick the
+        # numpy twin at this size)
+        session.conf.set(C.EXECUTION_DEVICE_JOIN_MIN_ROWS, 0)
+        rng = np.random.default_rng(23)
+        n1, n2 = 300, 500
+        a = pa.table(
+            {
+                "k1": pa.array(
+                    [None if i % 17 == 0 else int(x) for i, x in
+                     enumerate(rng.integers(0, 12, n1))],
+                    type=pa.int64(),
+                ),
+                "k2": pa.array(rng.integers(0, 5, n1), type=pa.int64()),
+                "va": pa.array(rng.normal(size=n1)),
+            }
+        )
+        b = pa.table(
+            {
+                "j1": pa.array(
+                    [None if i % 13 == 0 else int(x) for i, x in
+                     enumerate(rng.integers(0, 12, n2))],
+                    type=pa.int64(),
+                ),
+                "j2": pa.array(rng.integers(0, 5, n2), type=pa.int64()),
+                "vb": pa.array(rng.integers(0, 100, n2), type=pa.int64()),
+            }
+        )
+        (tmp_path / "a").mkdir(), (tmp_path / "b").mkdir()
+        pq.write_table(a, tmp_path / "a" / "p.parquet")
+        pq.write_table(b, tmp_path / "b" / "p.parquet")
+        dfa = session.read.parquet(str(tmp_path / "a"))
+        dfb = session.read.parquet(str(tmp_path / "b"))
+        hs.create_index(dfa, CoveringIndexConfig("ab_idx", ["k1", "k2"], ["va"]))
+        hs.create_index(dfb, CoveringIndexConfig("bb_idx", ["j1", "j2"], ["vb"]))
+        q = lambda l, r: (
+            l.join(r, on=(l["k1"] == r["j1"]) & (l["k2"] == r["j2"]))
+            .select("k1", "k2", "va", "vb")
+        )
+        session.disable_hyperspace()
+        base = q(dfa, dfb).collect()
+        session.enable_hyperspace()
+        plan = q(dfa, dfb).explain()
+        assert plan.count("Hyperspace(Type: CI") == 2, plan
+        got = q(dfa, dfb).collect()
+        assert sorted_table(got).equals(sorted_table(base))
+        # pyarrow cross-check that nulls never joined
+        assert not any(v is None for v in got.column("k1").to_pylist())
+
+    def test_join_key_equal_to_pad_sentinel(self, session, hs):
+        """A real INT64_MAX join key must not be dropped as kernel padding
+        (positional validity under stable argsort, ops/join.py)."""
+        from hyperspace_tpu.execution.join_exec import co_bucketed_join
+        from hyperspace_tpu.io.columnar import ColumnarBatch
+
+        MAX = (1 << 63) - 1
+        l = ColumnarBatch.from_arrow(
+            pa.table(
+                {"k": pa.array([1, MAX, 5], type=pa.int64()), "a": [10, 20, 30]}
+            )
+        )
+        r = ColumnarBatch.from_arrow(
+            pa.table(
+                {"j": pa.array([MAX, 5, MAX], type=pa.int64()), "b": [1, 2, 3]}
+            )
+        )
+        out = co_bucketed_join({0: l}, {0: r}, [("k", "j")], None)
+        rows = sorted(
+            zip(out.column("k").values.tolist(), out.column("b").values.tolist())
+        )
+        assert rows == [(5, 2), (MAX, 1), (MAX, 3)]

@@ -62,3 +62,37 @@ def test_resolve_nested():
     assert r[0].normalized_name == "__hs_nested.a.b"
     back = ResolvedColumn.from_normalized("__hs_nested.a.b")
     assert back.is_nested and back.name == "a.b"
+
+
+class TestReadTableSchemaEvolution:
+    def test_multi_file_type_widening(self, tmp_path):
+        """Batched multi-file read must fall back to permissive concat when
+        schemas differ (Delta/Iceberg type widening)."""
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from hyperspace_tpu.io.parquet import read_table
+
+        p1 = tmp_path / "a.parquet"
+        p2 = tmp_path / "b.parquet"
+        pq.write_table(pa.table({"y": pa.array([1, 2], type=pa.int32())}), p1)
+        big = 1 << 40
+        pq.write_table(pa.table({"y": pa.array([big], type=pa.int64())}), p2)
+        t = read_table([str(p1), str(p2)])
+        assert t.column("y").type == pa.int64()
+        assert t.column("y").to_pylist() == [1, 2, big]
+
+    def test_multi_file_same_schema_order(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from hyperspace_tpu.io.parquet import read_table
+
+        paths = []
+        for i in range(6):
+            p = tmp_path / f"f{i}.parquet"
+            pq.write_table(pa.table({"x": pa.array([i] * 3)}), p)
+            paths.append(str(p))
+        t = read_table(paths)
+        assert t.column("x").to_pylist() == [v for i in range(6) for v in [i] * 3]
