@@ -13,8 +13,40 @@ Dtype policy: hot kernels (hash, sort keys, z-address) run on 32-bit words
 into (lo, hi) uint32 planes at the host boundary. x64 is still enabled
 globally because payload columns (int64 values, file ids) must round-trip
 through device exchanges losslessly.
+
+Shape policy: every kernel pads its row dimension up to the next power of
+two before dispatch (:func:`pad_len`). Under jit each distinct input shape
+is a fresh XLA compile — on TPU a large sort alone costs tens of seconds
+of compile — so row counts must never leak into compiled shapes. Padding
+buys an O(log n)-sized shape universe: any two datasets within a 2x size
+band share every kernel binary. Combined with the persistent compilation
+cache (below), steady-state builds and queries never recompile.
 """
+
+import os
 
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache. TPU sort kernels take 40-80s to
+# compile while executing in milliseconds; caching them on disk makes every
+# process after the first pay only dispatch cost. Opt out (or relocate)
+# via HYPERSPACE_JAX_CACHE_DIR; "off" disables.
+_cache_dir = os.environ.get(
+    "HYPERSPACE_JAX_CACHE_DIR",
+    os.path.join(os.path.expanduser("~"), ".cache", "hyperspace_tpu", "jax"),
+)
+if _cache_dir.lower() != "off":
+    try:
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+    except Exception:  # older jax without the knobs: in-memory cache only
+        pass
+
+
+def pad_len(n: int, minimum: int = 8) -> int:
+    """Next power of two >= max(n, minimum) — the padded row count every
+    kernel dispatches at (see module docstring)."""
+    n = max(n, minimum)
+    return 1 << (n - 1).bit_length()

@@ -93,7 +93,19 @@ def _bucket_ids_words(words, num_buckets: int, seed: int):
 
 def bucket_ids_np(key_reps: np.ndarray, num_buckets: int, seed: int = 42) -> np.ndarray:
     """Host entry: [k, n] int64 key reps -> int32 bucket ids (device-computed
-    in 32-bit words)."""
-    return np.asarray(
-        _bucket_ids_words(jnp.asarray(split_words_np(key_reps)), num_buckets, seed)
-    )
+    in 32-bit words). Rows are padded to a power of two so the kernel
+    compiles once per 2x size band (ops/__init__ shape policy)."""
+    from hyperspace_tpu.ops import pad_len
+
+    n = key_reps.shape[1]
+    if n == 0:
+        return np.zeros(0, dtype=np.int32)
+    words = split_words_np(key_reps)
+    n_pad = pad_len(n)
+    if n_pad != n:
+        words = np.concatenate(
+            [words, np.zeros((words.shape[0], n_pad - n), dtype=np.uint32)],
+            axis=1,
+        )
+    out = np.asarray(_bucket_ids_words(jnp.asarray(words), num_buckets, seed))
+    return out[:n]
