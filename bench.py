@@ -1,0 +1,208 @@
+"""Benchmark harness — measured numbers on the real chip.
+
+Runs the BASELINE.md config-ladder shapes that fit one chip:
+
+  * config 1/2 analogue: covering index build over a TPC-H-like
+    ``lineitem`` (int64 key + date + payload), then an indexed point
+    filter (FilterIndexRule serve path) vs the unindexed scan;
+  * config 3 analogue: ``orders ⋈ lineitem`` via JoinIndexRule
+    (co-bucketed, shuffle-free) vs the unindexed sort-merge join.
+
+The Spark-CPU column of BASELINE.md cannot be produced here (the
+reference is a JVM/Spark library; no Spark runtime in this image), so
+``vs_baseline`` is the measured speedup of the indexed path over the
+unindexed path *on the same chip* — the reference's own headline claim
+(query acceleration from index-based plan rewrites) measured natively.
+
+Prints exactly ONE JSON line on stdout; progress goes to stderr.
+
+Env knobs: HS_BENCH_ROWS (lineitem rows, default 4M), HS_BENCH_REPS
+(timing reps, default 5), HS_BENCH_BUCKETS (default 8).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+# NOTE: no JAX_PLATFORMS override — this must run on the real chip when
+# one is attached (tests force cpu; the bench must not).
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def p50(fn, reps: int) -> float:
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def gen_data(tmp: str, n_items: int, n_orders: int, n_files: int = 8):
+    rng = np.random.default_rng(7)
+    items_dir = os.path.join(tmp, "lineitem")
+    orders_dir = os.path.join(tmp, "orders")
+    os.makedirs(items_dir)
+    os.makedirs(orders_dir)
+    # lineitem: key skewed across orders, date + qty + price payload
+    l_orderkey = rng.integers(0, n_orders, n_items, dtype=np.int64)
+    base_date = np.datetime64("1994-01-01")
+    l_shipdate = base_date + rng.integers(0, 2400, n_items).astype("timedelta64[D]")
+    l_quantity = rng.integers(1, 51, n_items, dtype=np.int64)
+    l_extendedprice = rng.normal(30000, 8000, n_items)
+    items = pa.table(
+        {
+            "l_orderkey": l_orderkey,
+            "l_shipdate": pa.array(l_shipdate.astype("datetime64[D]")),
+            "l_quantity": l_quantity,
+            "l_extendedprice": l_extendedprice,
+        }
+    )
+    o_orderkey = np.arange(n_orders, dtype=np.int64)
+    orders = pa.table(
+        {
+            "o_orderkey": o_orderkey,
+            "o_custkey": rng.integers(0, max(n_orders // 10, 1), n_orders),
+            "o_totalprice": rng.normal(150000, 30000, n_orders),
+        }
+    )
+    for i in range(n_files):
+        lo, hi = i * n_items // n_files, (i + 1) * n_items // n_files
+        pq.write_table(items.slice(lo, hi - lo), os.path.join(items_dir, f"part{i}.parquet"))
+        lo, hi = i * n_orders // n_files, (i + 1) * n_orders // n_files
+        pq.write_table(orders.slice(lo, hi - lo), os.path.join(orders_dir, f"part{i}.parquet"))
+    return items_dir, orders_dir
+
+
+def main() -> None:
+    n_items = int(os.environ.get("HS_BENCH_ROWS", 4_000_000))
+    n_orders = max(n_items // 8, 1)
+    reps = int(os.environ.get("HS_BENCH_REPS", 5))
+    num_buckets = int(os.environ.get("HS_BENCH_BUCKETS", 8))
+
+    import jax
+
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.hyperspace import Hyperspace
+    from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+    from hyperspace_tpu.session import HyperspaceSession
+
+    platform = jax.devices()[0].platform
+    log(f"bench: devices={jax.devices()} rows={n_items:,} buckets={num_buckets}")
+
+    tmp = tempfile.mkdtemp(prefix="hs_bench_")
+    try:
+        items_dir, orders_dir = gen_data(tmp, n_items, n_orders)
+        session = HyperspaceSession()
+        session.conf.set(C.INDEX_SYSTEM_PATH, os.path.join(tmp, "indexes"))
+        session.conf.set(C.INDEX_NUM_BUCKETS, num_buckets)
+        hs = Hyperspace(session)
+        items = session.read.parquet(items_dir)
+        orders = session.read.parquet(orders_dir)
+
+        # --- index build (cold = includes XLA compile; warm = steady state)
+        cfg_l = CoveringIndexConfig(
+            "l_idx", ["l_orderkey"], ["l_shipdate", "l_quantity", "l_extendedprice"]
+        )
+        t0 = time.perf_counter()
+        hs.create_index(items, cfg_l)
+        build_cold = time.perf_counter() - t0
+        hs.delete_index("l_idx")
+        hs.vacuum_index("l_idx")
+        session.index_manager.clear_cache()
+        t0 = time.perf_counter()
+        hs.create_index(items, cfg_l)
+        build_warm = time.perf_counter() - t0
+        log(
+            f"build lineitem index: cold {build_cold:.2f}s, warm {build_warm:.2f}s "
+            f"({n_items / build_warm:,.0f} rows/s warm)"
+        )
+        cfg_o = CoveringIndexConfig("o_idx", ["o_orderkey"], ["o_custkey", "o_totalprice"])
+        hs.create_index(orders, cfg_o)
+
+        # --- point filter (FilterIndexRule serve path, bucket-pruned)
+        session.conf.set(C.INDEX_FILTER_RULE_USE_BUCKET_SPEC, True)
+        key = int(n_orders // 3)
+
+        def q_filter(df):
+            return df.filter(df["l_orderkey"] == key).select(
+                "l_orderkey", "l_shipdate", "l_quantity"
+            )
+
+        session.enable_hyperspace()
+        plan = q_filter(items).explain()
+        if "Hyperspace(Type: CI" not in plan:
+            log(f"WARNING: filter not index-served:\n{plan}")
+        indexed_rows = q_filter(items).collect().num_rows  # warmup + sanity
+        filter_idx = p50(lambda: q_filter(items).collect(), reps)
+        session.disable_hyperspace()
+        base_rows = q_filter(items).collect().num_rows
+        assert base_rows == indexed_rows, (base_rows, indexed_rows)
+        filter_raw = p50(lambda: q_filter(items).collect(), reps)
+        log(
+            f"point filter p50: indexed {filter_idx * 1e3:.1f}ms vs "
+            f"unindexed {filter_raw * 1e3:.1f}ms ({filter_raw / filter_idx:.2f}x)"
+        )
+
+        # --- indexed join (JoinIndexRule, co-bucketed, shuffle-free)
+        def q_join(o, i):
+            return o.join(i, on=o["o_orderkey"] == i["l_orderkey"]).select(
+                "o_orderkey", "o_custkey", "l_quantity"
+            )
+
+        session.enable_hyperspace()
+        plan = q_join(orders, items).explain()
+        if plan.count("Hyperspace(Type: CI") != 2:
+            log(f"WARNING: join not index-served on both sides:\n{plan}")
+        j_rows = q_join(orders, items).collect().num_rows
+        join_idx = p50(lambda: q_join(orders, items).collect(), reps)
+        session.disable_hyperspace()
+        jb_rows = q_join(orders, items).collect().num_rows
+        assert j_rows == jb_rows, (j_rows, jb_rows)
+        join_raw = p50(lambda: q_join(orders, items).collect(), reps)
+        log(
+            f"join p50: indexed {join_idx * 1e3:.1f}ms vs "
+            f"unindexed {join_raw * 1e3:.1f}ms ({join_raw / join_idx:.2f}x)"
+        )
+
+        speedup = join_raw / join_idx
+        print(
+            json.dumps(
+                {
+                    "metric": "indexed_join_speedup",
+                    "value": round(speedup, 3),
+                    "unit": "x (unindexed p50 / indexed p50, same chip)",
+                    "vs_baseline": round(speedup, 3),
+                    "platform": platform,
+                    "rows": n_items,
+                    "num_buckets": num_buckets,
+                    "build_rows_per_sec": round(n_items / build_warm),
+                    "build_cold_s": round(build_cold, 3),
+                    "build_warm_s": round(build_warm, 3),
+                    "filter_indexed_p50_ms": round(filter_idx * 1e3, 2),
+                    "filter_unindexed_p50_ms": round(filter_raw * 1e3, 2),
+                    "filter_speedup": round(filter_raw / filter_idx, 3),
+                    "join_indexed_p50_ms": round(join_idx * 1e3, 2),
+                    "join_unindexed_p50_ms": round(join_raw * 1e3, 2),
+                    "join_rows_out": j_rows,
+                }
+            )
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -41,8 +41,9 @@ def _exec(plan: LogicalPlan, needed: Set[str], session) -> ColumnarBatch:
     if isinstance(plan, Scan):
         return _exec_scan(plan, needed, session)
     if isinstance(plan, Filter):
+        child = _bucket_pruned_scan(plan.child, plan.condition)
         child_needed = set(needed) | E.references(plan.condition)
-        batch = _exec(plan.child, child_needed, session)
+        batch = _exec(child, child_needed, session)
         return batch.filter(_filter_mask(plan.condition, batch))
     if isinstance(plan, Project):
         batch = _exec(plan.child, set(plan.columns), session)
@@ -120,6 +121,98 @@ def _exec_join(plan: Join, needed: Set[str], session) -> ColumnarBatch:
     left = _exec(plan.left, l_needed, session)
     right = _exec(plan.right, r_needed, session)
     return inner_join(left, right, on)
+
+
+def _literal_key_rep(value, arrow_type):
+    """The literal's int64 key rep under the same path data takes
+    (Column.key_rep), or None when it cannot be represented losslessly."""
+    import pyarrow as pa
+
+    from hyperspace_tpu.io.columnar import Column
+
+    try:
+        arr = pa.array([value], type=arrow_type)
+    except (pa.ArrowInvalid, pa.ArrowTypeError, OverflowError, TypeError):
+        return None
+    col = Column.from_arrow(arr)
+    if col.null_mask is not None:
+        return None
+    return int(col.key_rep()[0])
+
+
+_MAX_PRUNE_COMBOS = 64
+
+
+def _bucket_pruned_scan(plan: LogicalPlan, cond: E.Expr) -> LogicalPlan:
+    """Bucket pruning: when a filter over a bucketed index scan pins every
+    bucket column to literals (Eq / In conjuncts), drop the bucket files
+    that cannot contain matching rows.
+
+    The executor-side payoff of FilterIndexRule's bucketSpec — the
+    reference gets this from Spark's bucket pruning when
+    ``index.filterRule.useBucketSpec`` is on (IndexConstants.scala:56-57);
+    here it turns a point lookup into a read of 1/num_buckets of the index.
+    """
+    import dataclasses
+    import itertools
+
+    from hyperspace_tpu.io.parquet import bucket_id_of_file
+    from hyperspace_tpu.ops.hash import bucket_ids_np
+
+    if not isinstance(plan, Scan) or plan.relation.bucket_spec is None:
+        return plan
+    rel = plan.relation
+    num_buckets, bucket_cols = rel.bucket_spec
+    schema = rel.schema
+    conjuncts = E.split_conjuncts(cond)
+    value_lists = []
+    for bc in bucket_cols:
+        vals = None
+        for cj in conjuncts:
+            norm = E.normalize_comparison(cj)
+            if norm is not None:
+                op, name, lit = norm
+                if op == "=" and name.lower() == bc.lower():
+                    vals = [lit]
+                    break
+            elif (
+                isinstance(cj, E.In)
+                and isinstance(cj.child, E.Col)
+                and cj.child.name.lower() == bc.lower()
+            ):
+                vals = [v for v in cj.values if v is not None]
+                break
+        if not vals:
+            return plan  # bucket column not pinned: no pruning
+        value_lists.append(vals)
+    n_combos = 1
+    for vl in value_lists:
+        n_combos *= len(vl)
+    if n_combos > _MAX_PRUNE_COMBOS:
+        return plan
+    rep_lists = []
+    for bc, vals in zip(bucket_cols, value_lists):
+        reps = []
+        for v in vals:
+            rep = _literal_key_rep(v, schema[bc])
+            if rep is None:
+                return plan
+            reps.append(rep)
+        rep_lists.append(reps)
+    # one kernel dispatch over all combinations: [k, n_combos]
+    combos = np.array(
+        list(itertools.product(*rep_lists)), dtype=np.int64
+    ).T.reshape(len(bucket_cols), -1)
+    keep_buckets = set(bucket_ids_np(combos, num_buckets).tolist())
+    bucket_of = {f: bucket_id_of_file(f) for f in rel.files}
+    kept = tuple(
+        f
+        for f in rel.files
+        if bucket_of[f] is None or bucket_of[f] in keep_buckets
+    )
+    if len(kept) == len(rel.files):
+        return plan
+    return Scan(dataclasses.replace(rel, files=kept))
 
 
 def _bucket_layout(plan: LogicalPlan):

@@ -144,10 +144,14 @@ def co_bucketed_join(
         combined[bad] = (
             np.int64(-0x4000000000000000) - 2 * np.arange(len(bad)) - parity
         )
-        n_max = max(sizes) if sizes else 0
+        from hyperspace_tpu.ops import pad_len
+
+        # bucket width padded to a power of two (ops/__init__ shape policy:
+        # the match kernel compiles once per 2x band of max-bucket size)
+        width = pad_len(max(sizes) if sizes else 1)
         B = len(sizes)
-        padded = np.full((B, max(n_max, 1)), np.int64(0x7FFFFFFFFFFFFFFF))
-        rowmap = np.zeros((B, max(n_max, 1)), dtype=np.int64)
+        padded = np.full((B, width), np.int64(0x7FFFFFFFFFFFFFFF))
+        rowmap = np.zeros((B, width), dtype=np.int64)
         for i, (sz, off) in enumerate(zip(sizes, offs)):
             padded[i, :sz] = combined[off : off + sz]
             rowmap[i, :sz] = np.arange(off, off + sz)

@@ -56,19 +56,9 @@ def _column_min_max(col: Column):
     return v.min().item(), v.max().item()
 
 
-def _normalize_conjunct(expr: E.Expr):
-    """-> (op, column_name, literal) for Col-vs-Lit comparisons, else None."""
-    if not isinstance(expr, (E.Eq, E.Ne, E.Lt, E.Le, E.Gt, E.Ge)):
-        return None
-    left, right, op = expr.left, expr.right, expr.op
-    flipped = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "!=": "!="}
-    if isinstance(left, E.Lit) and isinstance(right, E.Col):
-        left, right, op = right, left, flipped[op]
-    if isinstance(left, E.Col) and isinstance(right, E.Lit):
-        if right.value is None:
-            return None
-        return op, left.name, right.value
-    return None
+# Col-vs-Lit normalization lives in plan/expressions (shared with the
+# executor's bucket pruning); keep the historical local name.
+_normalize_conjunct = E.normalize_comparison
 
 
 class Sketch:

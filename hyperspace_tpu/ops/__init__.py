@@ -1,4 +1,4 @@
-"""Device (XLA/Pallas) kernels for the index data plane.
+"""Device (XLA) kernels for the index data plane.
 
 Everything in this package is jit-compilable JAX: bucket hashing
 (:mod:`hyperspace_tpu.ops.hash`), packed-key sorting
@@ -14,8 +14,13 @@ into (lo, hi) uint32 planes at the host boundary. x64 is still enabled
 globally because payload columns (int64 values, file ids) must round-trip
 through device exchanges losslessly.
 
-Shape policy: every kernel pads its row dimension up to the next power of
-two before dispatch (:func:`pad_len`). Under jit each distinct input shape
+Shape policy: every host kernel entry point pads its row dimension up to
+the next power of two before dispatch (:func:`pad_len`): bucket hashing
+(``hash.bucket_ids_np``), all sort paths (``sort.lexsort_perm``, used by
+``sort_permutation``/``ordering_permutation``/``zorder``), predicate
+evaluation (``filter.device_filter_mask``), the per-bucket join width
+(``execution/join_exec.side_arrays``) and the shuffle row dimension
+(``parallel/shuffle.bucket_shuffle``). Under jit each distinct input shape
 is a fresh XLA compile — on TPU a large sort alone costs tens of seconds
 of compile — so row counts must never leak into compiled shapes. Padding
 buys an O(log n)-sized shape universe: any two datasets within a 2x size
@@ -32,12 +37,13 @@ jax.config.update("jax_enable_x64", True)
 # Persistent XLA compilation cache. TPU sort kernels take 40-80s to
 # compile while executing in milliseconds; caching them on disk makes every
 # process after the first pay only dispatch cost. Opt out (or relocate)
-# via HYPERSPACE_JAX_CACHE_DIR; "off" disables.
+# via HYPERSPACE_JAX_CACHE_DIR; the exact value "off" disables (a
+# directory literally named off/OFF still works as a path).
 _cache_dir = os.environ.get(
     "HYPERSPACE_JAX_CACHE_DIR",
     os.path.join(os.path.expanduser("~"), ".cache", "hyperspace_tpu", "jax"),
 )
-if _cache_dir.lower() != "off":
+if _cache_dir != "off":
     try:
         jax.config.update("jax_compilation_cache_dir", _cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)

@@ -42,10 +42,13 @@ class _Prep:
     def __init__(self, batch):
         self.batch = batch
         self.args: List[Any] = []
+        self.row_slots: set = set()  # arg indices holding per-row arrays
         self._col_slots = {}
 
-    def _arg(self, v) -> int:
+    def _arg(self, v, per_row: bool = False) -> int:
         self.args.append(v)
+        if per_row:
+            self.row_slots.add(len(self.args) - 1)
         return len(self.args) - 1
 
     def _col(self, name: str):
@@ -55,13 +58,15 @@ class _Prep:
         col = self.batch.column(name)
         if col.kind == "string":
             ref = E._StringRef(col.codes, col.dictionary)
-            vals = self._arg(ref.rank_values().astype(np.int64))
-            valid = self._arg(ref.valid)
+            vals = self._arg(ref.rank_values().astype(np.int64), per_row=True)
+            valid = self._arg(ref.valid, per_row=True)
             spec = ("col", vals, valid, "string", name)
             self._col_slots[name] = (spec, ref)
             return self._col_slots[name]
-        vals = self._arg(col.values)
-        valid = -1 if col.validity is None else self._arg(col.validity)
+        vals = self._arg(col.values, per_row=True)
+        valid = (
+            -1 if col.validity is None else self._arg(col.validity, per_row=True)
+        )
         spec = ("col", vals, valid, "numeric", name)
         self._col_slots[name] = (spec, None)
         return self._col_slots[name]
@@ -229,11 +234,27 @@ def _run(spec, n, args: Tuple):
 
 def device_filter_mask(expr: E.Expr, batch) -> np.ndarray:
     """Evaluate a predicate on device; raises :class:`Unsupported` when the
-    expression needs the host path (``plan/expressions.filter_mask``)."""
+    expression needs the host path (``plan/expressions.filter_mask``).
+
+    Per-row args are padded to ``pad_len`` (ops/__init__ shape policy) so
+    the kernel compiles once per (predicate shape, 2x size band); pad rows
+    are sliced off the mask. Validity pads are False, so even spec nodes
+    that read validity alone (isnull) can't leak pad rows into downstream
+    consumers that might ignore the slice.
+    """
+    from hyperspace_tpu.ops import pad_len
+
     n = batch.num_rows
     if n == 0:
         return np.zeros(0, dtype=bool)
     p = _Prep(batch)
     spec = p.lower(expr)
-    args = tuple(jnp.asarray(a) for a in p.args)
-    return np.asarray(_run(spec, n, args))
+    n_pad = pad_len(n)
+    args = []
+    for i, a in enumerate(p.args):
+        a = np.asarray(a)
+        if i in p.row_slots and n_pad != n:
+            fill = np.zeros((n_pad - n,) + a.shape[1:], dtype=a.dtype)
+            a = np.concatenate([a, fill])
+        args.append(jnp.asarray(a))
+    return np.asarray(_run(spec, n_pad, tuple(args)))[:n]

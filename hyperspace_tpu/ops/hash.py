@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 import hyperspace_tpu.ops  # noqa: F401  (enables x64)
+from hyperspace_tpu.ops import pad_len
 
 _C1 = np.uint32(0xCC9E2D51)
 _C2 = np.uint32(0x1B873593)
@@ -95,8 +96,6 @@ def bucket_ids_np(key_reps: np.ndarray, num_buckets: int, seed: int = 42) -> np.
     """Host entry: [k, n] int64 key reps -> int32 bucket ids (device-computed
     in 32-bit words). Rows are padded to a power of two so the kernel
     compiles once per 2x size band (ops/__init__ shape policy)."""
-    from hyperspace_tpu.ops import pad_len
-
     n = key_reps.shape[1]
     if n == 0:
         return np.zeros(0, dtype=np.int32)

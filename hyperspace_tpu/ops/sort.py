@@ -43,6 +43,33 @@ def lexsort_indices(word_planes):
     return jnp.lexsort(word_planes[::-1])
 
 
+def lexsort_perm(planes: np.ndarray, n_valid: int | None = None) -> np.ndarray:
+    """Host dispatch of :func:`lexsort_indices` at a padded static shape.
+
+    Pads the row dimension to ``pad_len`` with ``0xFFFFFFFF`` in every
+    plane (the ops/__init__ shape policy: one compile per 2x size band).
+    Pad slots sort after every real row: their key is the maximum in all
+    planes and ``jnp.lexsort`` is stable, so a real row that ties still
+    precedes them (its index is smaller). The first ``n_valid`` outputs
+    are therefore exactly the sorted real rows.
+    """
+    from hyperspace_tpu.ops import pad_len
+
+    n = planes.shape[1] if n_valid is None else n_valid
+    if n == 0:
+        return np.zeros(0, dtype=np.int64)
+    planes = planes.astype(np.uint32, copy=False)
+    n_pad = pad_len(planes.shape[1])
+    if n_pad != planes.shape[1]:
+        fill = np.full(
+            (planes.shape[0], n_pad - planes.shape[1]),
+            np.uint32(0xFFFFFFFF),
+        )
+        planes = np.concatenate([planes, fill], axis=1)
+    perm = np.asarray(lexsort_indices(jnp.asarray(planes)))
+    return perm[:n]
+
+
 def sort_permutation(
     key_reps: np.ndarray, bucket: np.ndarray | None = None
 ) -> np.ndarray:
@@ -52,7 +79,7 @@ def sort_permutation(
         planes = np.concatenate(
             [bucket.astype(np.uint32)[None, :], planes]
         )
-    return np.asarray(lexsort_indices(jnp.asarray(planes)))
+    return lexsort_perm(planes)
 
 
 # ---------------------------------------------------------------------------
@@ -100,7 +127,9 @@ def order_rep(col) -> np.ndarray:
 def ordering_permutation(batch, keys) -> np.ndarray:
     """Stable permutation ordering ``batch`` by ``keys`` =
     ((column, ascending), ...). Nulls always sort last (pyarrow's
-    ``null_placement="at_end"``); descending flips values, not nulls."""
+    ``null_placement="at_end"``), and NaN always sorts after every other
+    value but before nulls — in BOTH directions, like pyarrow's sort_by.
+    Descending flips values only, never the null/NaN placement."""
     planes = []
     for name, asc in keys:
         col = batch.column(name)
@@ -114,5 +143,8 @@ def ordering_permutation(batch, keys) -> np.ndarray:
             else null.astype(np.uint32)
         )
         planes.append(null_plane)
+        if col.kind == "numeric" and col.values.dtype.kind == "f":
+            # direction-independent NaN plane (pyarrow: NaN after values)
+            planes.append(np.isnan(col.values).astype(np.uint32))
         planes.extend(_order_words_np(rep[None, :]))
-    return np.asarray(lexsort_indices(jnp.asarray(np.stack(planes))))
+    return lexsort_perm(np.stack(planes))

@@ -92,9 +92,22 @@ def z_order_permutation(columns: List, bits: int = 16) -> np.ndarray:
     """Sort permutation by z-address over the given Columns
     (the build-side replacement for repartitionByRange on ``_zaddr``,
     ZOrderCoveringIndex.scala:97-154)."""
+    from hyperspace_tpu.ops import pad_len
+
     encs = [order_u64_np(c) for c in columns]
     mins = [e.min() if len(e) else np.uint64(0) for e in encs]
     maxs = [e.max() if len(e) else np.uint64(0) for e in encs]
+    n = len(encs[0]) if encs else 0
+    n_pad = pad_len(max(n, 1))
+    if n_pad != n:
+        # pad rows encode as the max z-address and sort last (shape policy;
+        # lexsort_perm slices them off)
+        encs = [
+            np.concatenate(
+                [e, np.full(n_pad - n, np.uint64(0xFFFFFFFFFFFFFFFF))]
+            )
+            for e in encs
+        ]
     enc_hi = np.stack([(e >> np.uint64(32)).astype(np.uint32) for e in encs])
     enc_lo = np.stack([(e & np.uint64(0xFFFFFFFF)).astype(np.uint32) for e in encs])
     mins_hi = np.array(
@@ -116,6 +129,6 @@ def z_order_permutation(columns: List, bits: int = 16) -> np.ndarray:
         bits,
     )
     planes = _interleave(words, bits)
-    from hyperspace_tpu.ops.sort import lexsort_indices
+    from hyperspace_tpu.ops.sort import lexsort_perm
 
-    return np.asarray(lexsort_indices(planes))
+    return lexsort_perm(np.asarray(planes), n_valid=n)

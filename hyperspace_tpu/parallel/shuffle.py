@@ -103,9 +103,15 @@ def bucket_shuffle(
     within a shard, ascending bucket id). The caller does the final
     within-bucket key sort (``ops/sort.py``) before writing.
     """
+    from hyperspace_tpu.ops import pad_len
+
     D = mesh.devices.size
     n = key_reps.shape[1]
-    pad = (-n) % D
+    # power-of-two row count (ops/__init__ shape policy), then round up to
+    # a multiple of D so shard_map divides evenly
+    target = pad_len(n)
+    target += (-target) % D
+    pad = target - n
     if pad:
         key_reps = np.pad(key_reps, ((0, 0), (0, pad)))
         payloads = [np.pad(p, (0, pad)) for p in payloads]

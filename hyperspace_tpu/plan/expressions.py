@@ -215,6 +215,24 @@ def conjunction(exprs: List[Expr]) -> Optional[Expr]:
     return out
 
 
+def normalize_comparison(expr: Expr) -> Optional[Tuple[str, str, Any]]:
+    """-> (op, column_name, literal) for Col-vs-Lit comparisons (either
+    operand order; never a None literal), else None. The single home of
+    the operand-swap rule (shared by sketch predicate translation and
+    executor bucket pruning)."""
+    if not isinstance(expr, (Eq, Ne, Lt, Le, Gt, Ge)):
+        return None
+    left, right, op = expr.left, expr.right, expr.op
+    flipped = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "!=": "!="}
+    if isinstance(left, Lit) and isinstance(right, Col):
+        left, right, op = right, left, flipped[op]
+    if isinstance(left, Col) and isinstance(right, Lit):
+        if right.value is None:
+            return None
+        return op, left.name, right.value
+    return None
+
+
 def equi_join_pairs(cond: Expr) -> Optional[List[Tuple[str, str]]]:
     """If cond is a conjunction of Col == Col, the (left, right) name pairs;
     else None (JoinIndexRule CNF equi-condition check :164-170)."""

@@ -153,6 +153,63 @@ class TestFilterIndexServe:
         plan = df.filter(df["clicks"] > 1).select("clicks").explain()
         assert "Hyperspace" not in plan
 
+    def test_bucket_pruned_point_filter(self, session, hs, sample_parquet):
+        """With useBucketSpec on, a point filter reads only the bucket
+        file(s) the literal hashes to, and the answer is unchanged."""
+        from hyperspace_tpu.execution.executor import _bucket_pruned_scan
+        from hyperspace_tpu.plan.nodes import Filter, Project, Scan
+
+        df = session.read.parquet(sample_parquet)
+        hs.create_index(df, CoveringIndexConfig("idx1", ["clicks"], ["query"]))
+        session.conf.set(C.INDEX_FILTER_RULE_USE_BUCKET_SPEC, True)
+        session.enable_hyperspace()
+        key = int(df.collect().column("clicks")[0].as_py())
+        q = lambda d: d.filter(d["clicks"] == key).select("clicks", "query")
+        optimized = session.optimize(q(df).logical_plan)
+        # walk to the Filter->Scan and check pruning drops files
+        node = optimized
+        while not isinstance(node, Filter):
+            node = node.child
+        assert isinstance(node.child, Scan)
+        assert node.child.relation.bucket_spec is not None
+        pruned = _bucket_pruned_scan(node.child, node.condition)
+        assert len(pruned.relation.files) < len(node.child.relation.files)
+        # differential: pruned answer == unindexed answer
+        session.disable_hyperspace()
+        without = q(df).collect()
+        session.enable_hyperspace()
+        got = q(df).collect()
+        assert sorted_table(got).equals(sorted_table(without))
+        assert got.num_rows > 0
+
+    def test_bucket_pruned_in_filter(self, session, hs, sample_parquet):
+        """IN-list point filters prune to the union of the values' buckets."""
+        from hyperspace_tpu.execution.executor import _bucket_pruned_scan
+        from hyperspace_tpu.plan.nodes import Filter, Scan
+
+        df = session.read.parquet(sample_parquet)
+        hs.create_index(df, CoveringIndexConfig("idx_s", ["query"], ["clicks"]))
+        session.conf.set(C.INDEX_FILTER_RULE_USE_BUCKET_SPEC, True)
+        session.enable_hyperspace()
+        q = lambda d: d.filter(
+            d["query"].isin("banana", "donde")
+        ).select("query", "clicks")
+        plan = q(df).explain()
+        assert "Hyperspace(Type: CI, Name: idx_s" in plan
+        optimized = session.optimize(q(df).logical_plan)
+        node = optimized
+        while not isinstance(node, Filter):
+            node = node.child
+        assert isinstance(node.child, Scan)
+        pruned = _bucket_pruned_scan(node.child, node.condition)
+        assert len(pruned.relation.files) < len(node.child.relation.files)
+        session.disable_hyperspace()
+        without = q(df).collect()
+        session.enable_hyperspace()
+        got = q(df).collect()
+        assert sorted_table(got).equals(sorted_table(without))
+        assert got.num_rows > 0
+
     def test_string_indexed_column(self, session, hs, sample_parquet):
         df = session.read.parquet(sample_parquet)
         hs.create_index(df, CoveringIndexConfig("idx_s", ["query"], ["clicks"]))
