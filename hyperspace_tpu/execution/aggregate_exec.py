@@ -20,16 +20,23 @@ from hyperspace_tpu.ops.sort import order_rep
 from hyperspace_tpu.plan.nodes import AggSpec, _agg_output_type
 
 
-def _grouping_rep(col: Column) -> np.ndarray:
-    """Per-column int64 rep where equality == SQL group-by equality.
+def _grouping_planes(col: Column) -> List[np.ndarray]:
+    """Per-column int64 plane(s) where row equality == SQL group-by
+    equality.
 
     Strings use dictionary codes (exact within a batch — no hash
-    collisions); numerics use ``key_rep`` (canonicalizes NaN/-0.0 and maps
-    nulls to one sentinel, so they form single groups as SQL requires).
+    collisions; code -1 is null, one group as SQL requires). Numerics use
+    ``key_rep`` (canonicalizes NaN/-0.0) plus, when the column has nulls,
+    an explicit null plane — the rep maps null to an in-band value a real
+    key could equal, so the plane is what keeps nulls a separate group.
     """
     if col.kind == "string":
-        return col.codes.astype(np.int64)
-    return col.key_rep()
+        return [col.codes.astype(np.int64)]
+    planes = [col.key_rep()]
+    null = col.null_mask
+    if null is not None:
+        planes.append(null.astype(np.int64))
+    return planes
 
 
 def _factorize(batch: ColumnarBatch, group_by: List[str]) -> Tuple[np.ndarray, np.ndarray, int]:
@@ -37,7 +44,10 @@ def _factorize(batch: ColumnarBatch, group_by: List[str]) -> Tuple[np.ndarray, n
     n = batch.num_rows
     if not group_by:
         return np.zeros(n, dtype=np.int64), np.zeros(0, dtype=np.int64), 1
-    reps = np.stack([_grouping_rep(batch.column(c)) for c in group_by])
+    planes: List[np.ndarray] = []
+    for c in group_by:
+        planes.extend(_grouping_planes(batch.column(c)))
+    reps = np.stack(planes)
     rows = np.ascontiguousarray(reps.T)
     voids = rows.view([("", rows.dtype)] * rows.shape[1]).ravel()
     _, first, gid = np.unique(voids, return_index=True, return_inverse=True)

@@ -71,9 +71,83 @@ def _exec(plan: LogicalPlan, needed: Set[str], session) -> ColumnarBatch:
             return batch
         return batch.take(ordering_permutation(batch, plan.keys))
     if isinstance(plan, Limit):
-        batch = _exec(plan.child, needed, session)
-        return batch.take(np.arange(min(plan.n, batch.num_rows)))
+        return _exec_limit(plan.n, plan.child, needed, session)
     raise HyperspaceException(f"Unknown plan node: {type(plan).__name__}")
+
+
+def _exec_limit(n: int, child: LogicalPlan, needed: Set[str], session) -> ColumnarBatch:
+    """Limit execution that avoids materializing the full child.
+
+    * Limit∘Sort = top-n: sort the permutation, materialize only n rows;
+    * Limit pushes through Project and Union (row order is the child's
+      deterministic order, so taking the first n of the left side first
+      is exactly what the naive path produced);
+    * Limit∘Scan / Limit∘Filter∘Scan stream file-by-file and stop as
+      soon as n rows are produced.
+    The reference gets all of this from Spark's CollectLimitExec /
+    LocalLimit pushdown; the naive path here executed and sorted the
+    entire child before truncating.
+    """
+    import dataclasses
+
+    if n <= 0:
+        import pyarrow as pa
+
+        schema = child.schema()
+        cols = [c for c in child.output if c in needed] or child.output[:1]
+        return ColumnarBatch.from_arrow(
+            pa.table({c: pa.array([], type=schema[c]) for c in cols})
+        )
+    if isinstance(child, Sort):
+        from hyperspace_tpu.ops.sort import ordering_permutation
+
+        child_needed = set(needed) | {c for c, _ in child.keys}
+        batch = _exec(child.child, child_needed, session)
+        if batch.num_rows == 0:
+            return batch
+        perm = ordering_permutation(batch, child.keys)
+        return batch.take(perm[: min(n, batch.num_rows)])
+    if isinstance(child, Project):
+        return _exec_limit(
+            n, child.child, set(child.columns), session
+        ).select(child.columns)
+    if isinstance(child, Union):
+        cols = [c for c in child.output if c in needed] or child.output[:1]
+        left = _exec_limit(n, child.left, set(cols), session).select(cols)
+        if left.num_rows >= n:
+            return left.take(np.arange(n))
+        right = _exec_limit(
+            n - left.num_rows, child.right, set(cols), session
+        ).select(cols)
+        return ColumnarBatch.concat([left, right])
+    # file-by-file streaming for Scan / Filter(Scan) over footer-counted
+    # formats without post-read row filtering
+    scan = child.child if isinstance(child, Filter) else child
+    streamable = (
+        isinstance(scan, Scan)
+        and scan.relation.fmt in ("parquet", "delta", "iceberg")
+        and scan.relation.excluded_file_ids is None
+        and len(scan.relation.files) > 1
+    )
+    if streamable:
+        parts: list = []
+        got = 0
+        for f in scan.relation.files:
+            sub_scan = Scan(dataclasses.replace(scan.relation, files=(f,)))
+            sub: LogicalPlan = (
+                Filter(child.condition, sub_scan)
+                if isinstance(child, Filter)
+                else sub_scan
+            )
+            b = _exec(sub, needed, session)
+            parts.append(b)
+            got += b.num_rows
+            if got >= n:
+                break
+        batch = ColumnarBatch.concat(parts)
+        return batch.take(np.arange(min(n, batch.num_rows)))
+    batch = _exec(child, needed, session)
+    return batch.take(np.arange(min(n, batch.num_rows)))
 
 
 def _exec_join(plan: Join, needed: Set[str], session) -> ColumnarBatch:

@@ -119,7 +119,6 @@ def co_bucketed_join(
     Returns the joined batch, or None when the sides share no bucket (the
     caller builds the schema-correct empty result).
     """
-    from hyperspace_tpu.io.columnar import NULL_KEY_REP
     from hyperspace_tpu.ops.join import bucketed_match_ranges, combine_reps_np
 
     buckets = sorted(set(lbs) & set(rbs))
@@ -135,7 +134,7 @@ def co_bucketed_join(
 
     def side_arrays(batch, sizes, offs, cols, parity):
         reps = batch.key_reps(cols)  # kept for exact verification below
-        ok = ~(reps == NULL_KEY_REP).any(axis=0)
+        ok = ~batch.null_any(cols)  # explicit masks, not the in-band rep
         combined = combine_reps_np(reps)
         # exclude null keys from matching (SQL: null never equals null):
         # give each null row a unique sentinel; left uses even offsets and
@@ -211,12 +210,11 @@ def inner_join(
     from both sides kept, as in the logical Join's output contract)."""
     l_reps = left.key_reps([l for l, _ in on])
     r_reps = right.key_reps([r for _, r in on])
-    # Null keys never match (SQL semantics): reps encode null as a sentinel
-    # which would match null-to-null, so mask them out first.
-    from hyperspace_tpu.io.columnar import NULL_KEY_REP
-
-    l_ok = ~(l_reps == NULL_KEY_REP).any(axis=0)
-    r_ok = ~(r_reps == NULL_KEY_REP).any(axis=0)
+    # Null keys never match (SQL semantics): reps encode null as an in-band
+    # value which would match null-to-null (and could equal a real key), so
+    # exclude null rows via the explicit masks.
+    l_ok = ~left.null_any([l for l, _ in on])
+    r_ok = ~right.null_any([r for _, r in on])
     l_map = np.nonzero(l_ok)[0]
     r_map = np.nonzero(r_ok)[0]
     li, ri = merge_join_indices(l_reps[:, l_ok], r_reps[:, r_ok])

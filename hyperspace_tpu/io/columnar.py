@@ -37,7 +37,11 @@ import pyarrow as pa
 from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.utils.hashing import murmur3_64_bytes
 
-# Key rep reserved for nulls. Chosen to be an unlikely hash/bit pattern.
+# Key rep assigned to nulls: an arbitrary-but-consistent VALUE so nulls
+# bucket/sort deterministically. It is NOT a detection mechanism — a real
+# int64 key may legitimately equal it, so consumers that must distinguish
+# null rows (joins, group-by) read the explicit null masks
+# (Column.null_mask / ColumnarBatch.null_any), never compare reps to this.
 NULL_KEY_REP = np.int64(-0x7FFF_FFFF_FFFF_FF13)
 
 def _is_string(t: pa.DataType) -> bool:
@@ -301,6 +305,17 @@ class ColumnarBatch:
     def key_reps(self, names: Sequence[str]) -> np.ndarray:
         """[num_keys, num_rows] int64 key representations."""
         return np.stack([self.column(n).key_rep() for n in names])
+
+    def null_any(self, names: Sequence[str]) -> np.ndarray:
+        """[num_rows] bool: True where ANY named column is null. The
+        correct null-row detector for join/group-by semantics (reps encode
+        null as an in-band value; see NULL_KEY_REP)."""
+        out = np.zeros(self.num_rows, dtype=bool)
+        for n in names:
+            m = self.column(n).null_mask
+            if m is not None:
+                out |= m
+        return out
 
     @staticmethod
     def concat(batches: Sequence["ColumnarBatch"]) -> "ColumnarBatch":

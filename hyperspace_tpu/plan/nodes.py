@@ -303,10 +303,10 @@ def _agg_output_type(spec: AggSpec, child_schema) -> pa.DataType:
     if spec.func == "count":
         return pa.int64()
     t = child_schema[spec.column]
-    numeric = pa.types.is_floating(t) or pa.types.is_integer(t) or (
-        pa.types.is_boolean(t)
-    )
+    numeric = pa.types.is_floating(t) or pa.types.is_integer(t)
     if spec.func == "avg":
+        # booleans are NOT summable/averageable (Spark rejects
+        # sum/avg(boolean) at analysis time); min/max(bool) stays legal
         if not numeric:
             raise HyperspaceException(
                 f"avg() over non-numeric column {spec.column!r} ({t})"
@@ -318,6 +318,7 @@ def _agg_output_type(spec: AggSpec, child_schema) -> pa.DataType:
                 f"sum() over non-numeric column {spec.column!r} ({t})"
             )
         return pa.float64() if pa.types.is_floating(t) else pa.int64()
+    numeric = numeric or pa.types.is_boolean(t)
     # min/max preserve the input type; orderable = numeric/temporal/string
     if not (
         numeric

@@ -1,0 +1,174 @@
+"""Regression tests for advisor findings.
+
+(a) the in-band NULL key rep must never make a real int64 key behave as
+    null (joins dropping matches, aggregates mis-grouping);
+(b) descending float sorts keep NaN after values (pyarrow semantics);
+(c) sum/avg over booleans are rejected at plan time (Spark analysis-time
+    behavior); min/max(bool) stays legal;
+(d) limit does not execute/sort the full child.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import functions as F
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.io.columnar import NULL_KEY_REP
+
+SENTINEL = int(NULL_KEY_REP)  # a perfectly legal int64 key value
+
+
+def _write(tmp_path, name, table, n_files=1):
+    d = tmp_path / name
+    d.mkdir()
+    rows = table.num_rows
+    for i in range(n_files):
+        lo = i * rows // n_files
+        hi = (i + 1) * rows // n_files
+        pq.write_table(table.slice(lo, hi - lo), d / f"p{i}.parquet")
+    return str(d)
+
+
+class TestNullSentinelCollision:
+    def test_join_matches_real_key_equal_to_sentinel(self, session, tmp_path):
+        left = pa.table(
+            {
+                "k": pa.array([SENTINEL, 1, None], type=pa.int64()),
+                "lv": pa.array([10, 11, 12], type=pa.int64()),
+            }
+        )
+        right = pa.table(
+            {
+                "j": pa.array([SENTINEL, None, 2], type=pa.int64()),
+                "rv": pa.array([20, 21, 22], type=pa.int64()),
+            }
+        )
+        dl = session.read.parquet(_write(tmp_path, "l", left))
+        dr = session.read.parquet(_write(tmp_path, "r", right))
+        out = dl.join(dr, on=dl["k"] == dr["j"]).select("k", "lv", "rv").collect()
+        # the real sentinel-valued keys MUST match; nulls must not
+        assert out.num_rows == 1
+        assert out.column("k").to_pylist() == [SENTINEL]
+        assert out.column("lv").to_pylist() == [10]
+        assert out.column("rv").to_pylist() == [20]
+
+    def test_cobucketed_join_sentinel_and_null(self, session, tmp_path):
+        """Same property through the indexed (co-bucketed) join path."""
+        from hyperspace_tpu import constants as C
+        from hyperspace_tpu.hyperspace import Hyperspace
+        from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+
+        hs = Hyperspace(session)
+        left = pa.table(
+            {
+                "k": pa.array([SENTINEL, 1, None, 5], type=pa.int64()),
+                "lv": pa.array([10, 11, 12, 13], type=pa.int64()),
+            }
+        )
+        right = pa.table(
+            {
+                "j": pa.array([SENTINEL, None, 5, 5], type=pa.int64()),
+                "rv": pa.array([20, 21, 22, 23], type=pa.int64()),
+            }
+        )
+        dl = session.read.parquet(_write(tmp_path, "l", left, n_files=2))
+        dr = session.read.parquet(_write(tmp_path, "r", right, n_files=2))
+        hs.create_index(dl, CoveringIndexConfig("li", ["k"], ["lv"]))
+        hs.create_index(dr, CoveringIndexConfig("ri", ["j"], ["rv"]))
+        session.enable_hyperspace()
+        q = dl.join(dr, on=dl["k"] == dr["j"]).select("k", "lv", "rv")
+        plan = q.explain()
+        assert plan.count("Hyperspace(Type: CI") == 2
+        out = q.collect().sort_by([("rv", "ascending")])
+        assert out.column("k").to_pylist() == [SENTINEL, 5, 5]
+        assert out.column("rv").to_pylist() == [20, 22, 23]
+
+    def test_groupby_separates_sentinel_from_null(self, session, tmp_path):
+        t = pa.table(
+            {
+                "g": pa.array([SENTINEL, SENTINEL, None, None, 1], pa.int64()),
+                "v": pa.array([1, 2, 4, 8, 16], type=pa.int64()),
+            }
+        )
+        df = session.read.parquet(_write(tmp_path, "g", t))
+        out = df.group_by("g").agg(F.sum("v").alias("s")).collect()
+        got = {
+            (g if g is None else int(g)): s
+            for g, s in zip(out.column("g").to_pylist(), out.column("s").to_pylist())
+        }
+        assert got == {SENTINEL: 3, None: 12, 1: 16}
+
+
+class TestNaNDescending:
+    def test_matches_pyarrow_both_directions(self, session, tmp_path):
+        t = pa.table(
+            {"x": pa.array([1.0, float("nan"), -2.0, None, 5.0, float("nan")])}
+        )
+        df = session.read.parquet(_write(tmp_path, "n", t))
+        for asc, order in ((True, "ascending"), (False, "descending")):
+            got = df.sort(("x", asc)).collect().column("x").to_pylist()
+            want = t.sort_by([("x", order)]).column("x").to_pylist()
+            assert str(got) == str(want), (asc, got, want)
+
+
+class TestBooleanAggregates:
+    def test_sum_avg_bool_rejected_min_max_ok(self, session, tmp_path):
+        t = pa.table(
+            {
+                "b": pa.array([True, False, True]),
+                "g": pa.array([1, 1, 2], type=pa.int64()),
+            }
+        )
+        df = session.read.parquet(_write(tmp_path, "b", t))
+        with pytest.raises(HyperspaceException, match="sum"):
+            df.agg(F.sum("b")).collect()
+        with pytest.raises(HyperspaceException, match="avg"):
+            df.group_by("g").agg(F.avg("b")).collect()
+        out = df.agg(F.min("b").alias("lo"), F.max("b").alias("hi")).collect()
+        assert out.column("lo").to_pylist() == [False]
+        assert out.column("hi").to_pylist() == [True]
+
+
+class TestLimitPushdown:
+    def test_limit_reads_only_needed_files(self, session, tmp_path, monkeypatch):
+        t = pa.table({"x": pa.array(np.arange(1000), type=pa.int64())})
+        d = _write(tmp_path, "lim", t, n_files=10)
+        df = session.read.parquet(d)
+
+        from hyperspace_tpu.io import parquet as pio
+
+        seen = []
+        real = pio.read_table
+
+        def counting(paths, columns=None, fmt="parquet"):
+            seen.extend(paths)
+            return real(paths, columns, fmt)
+
+        monkeypatch.setattr(
+            "hyperspace_tpu.execution.executor.pio.read_table", counting
+        )
+        out = df.limit(5).collect()
+        assert out.num_rows == 5
+        # naive execution reads all 10 files; streaming stops at the first
+        assert len(seen) == 1
+        # the result is the same prefix the full read produces
+        assert out.column("x").to_pylist() == list(range(5))
+
+    def test_limit_through_filter_and_project(self, session, tmp_path):
+        t = pa.table({"x": pa.array(np.arange(100), type=pa.int64())})
+        d = _write(tmp_path, "limf", t, n_files=5)
+        df = session.read.parquet(d)
+        out = df.filter(df["x"] >= 50).select("x").limit(3).collect()
+        assert out.column("x").to_pylist() == [50, 51, 52]
+
+    def test_limit_over_sort_is_topn(self, session, tmp_path):
+        rng = np.random.default_rng(3)
+        t = pa.table({"x": pa.array(rng.permutation(200), type=pa.int64())})
+        d = _write(tmp_path, "lims", t, n_files=4)
+        df = session.read.parquet(d)
+        out = df.sort(("x", False)).limit(4).collect()
+        assert out.column("x").to_pylist() == [199, 198, 197, 196]
