@@ -68,6 +68,13 @@ class Config:
         return self.get_int(C.INDEX_NUM_BUCKETS, C.INDEX_NUM_BUCKETS_DEFAULT)
 
     @property
+    def build_memory_budget(self) -> int:
+        """Max bytes materialized per build wave (0 = unbounded)."""
+        return self.get_int(
+            C.INDEX_BUILD_MEMORY_BUDGET, C.INDEX_BUILD_MEMORY_BUDGET_DEFAULT
+        )
+
+    @property
     def lineage_enabled(self) -> bool:
         return self.get_bool(
             C.INDEX_LINEAGE_ENABLED, C.INDEX_LINEAGE_ENABLED_DEFAULT

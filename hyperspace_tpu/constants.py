@@ -85,6 +85,14 @@ INDEX_SOURCES_PROVIDERS_DEFAULT = (
 DEFAULT_SUPPORTED_FORMATS = "hyperspace.index.sources.defaultSupportedFormats"
 DEFAULT_SUPPORTED_FORMATS_DEFAULT = "csv,json,parquet"
 
+# Streaming build: cap the bytes materialized per wave of the covering
+# index build (0 = unbounded, one in-memory pass). The reference gets
+# disk-backed spill for free from Spark's shuffle
+# (covering/CoveringIndex.scala:58-61 repartition); our wave loop lives in
+# indexes/covering_build.py.
+INDEX_BUILD_MEMORY_BUDGET = "hyperspace.index.build.memoryBudgetBytes"
+INDEX_BUILD_MEMORY_BUDGET_DEFAULT = 0
+
 # Z-order (IndexConstants.scala:59-74)
 ZORDER_TARGET_SOURCE_BYTES_PER_PARTITION = (
     "hyperspace.index.zorder.targetSourceBytesPerPartition"

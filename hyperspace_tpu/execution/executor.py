@@ -130,10 +130,18 @@ def _exec_limit(n: int, child: LogicalPlan, needed: Set[str], session) -> Column
         and len(scan.relation.files) > 1
     )
     if streamable:
+        # geometric group sizes (1, 2, 4, …): a selective filter that ends
+        # up reading everything still gets the threaded multi-file read
+        # after the first few probes (log-many read_table calls total),
+        # while a satisfied limit stops after one small group
         parts: list = []
         got = 0
-        for f in scan.relation.files:
-            sub_scan = Scan(dataclasses.replace(scan.relation, files=(f,)))
+        files = list(scan.relation.files)
+        pos = 0
+        group = 1
+        while pos < len(files) and got < n:
+            chunk = tuple(files[pos : pos + group])
+            sub_scan = Scan(dataclasses.replace(scan.relation, files=chunk))
             sub: LogicalPlan = (
                 Filter(child.condition, sub_scan)
                 if isinstance(child, Filter)
@@ -142,8 +150,8 @@ def _exec_limit(n: int, child: LogicalPlan, needed: Set[str], session) -> Column
             b = _exec(sub, needed, session)
             parts.append(b)
             got += b.num_rows
-            if got >= n:
-                break
+            pos += len(chunk)
+            group *= 2
         batch = ColumnarBatch.concat(parts)
         return batch.take(np.arange(min(n, batch.num_rows)))
     batch = _exec(child, needed, session)

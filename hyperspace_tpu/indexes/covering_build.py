@@ -19,10 +19,20 @@ Single-host note: after the device exchange all shards live in this
 process, so one host writes every bucket. On a multi-host mesh each host
 writes only the buckets its local shards own; the layout (one file per
 bucket, bucket id in the file name) is identical.
+
+Datasets larger than the configured memory budget
+(``hyperspace.index.build.memoryBudgetBytes``) never materialize whole:
+``create_covering_index`` hands back a lazy :class:`SourceScan` and
+``_write_bucketed_streaming`` runs the pipeline in waves with per-bucket
+disk spill and a final per-bucket merge sort (peak memory = one wave +
+one bucket). The delete-compensation path of incremental refresh still
+materializes the previous index data (bounded by the index, not the
+source).
 """
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -64,6 +74,99 @@ def _scan_with_lineage(
     if not batches:
         raise HyperspaceException("No source files to index")
     return ColumnarBatch.concat(batches)
+
+
+def materialize_if_scan(data) -> ColumnarBatch:
+    """ColumnarBatch passthrough; a lazy :class:`SourceScan` is read whole.
+
+    For consumers that need the full dataset in memory regardless of the
+    build memory budget — today the z-order build, whose global min/max
+    normalization and total sort are not yet streamed."""
+    return data.materialize() if isinstance(data, SourceScan) else data
+
+
+@dataclasses.dataclass
+class SourceScan:
+    """Lazy build-side input: what to read, not the rows themselves.
+
+    The carrier of the >HBM streaming build — when the estimated
+    materialized size exceeds ``hyperspace.index.build.memoryBudgetBytes``
+    the build keeps this descriptor and ``write_bucketed`` streams it in
+    waves instead of materializing one giant batch (the role Spark's
+    disk-backed shuffle plays for the reference,
+    covering/CoveringIndex.scala:58-61).
+    """
+
+    files: Tuple[str, ...]
+    fmt: str
+    columns: Tuple[str, ...]  # projection to read
+    file_ids: Optional[Dict[str, int]]  # lineage ids (None = lineage off)
+    select_cols: Optional[Tuple[str, ...]] = None  # output column order
+    # per-file estimated materialized bytes, computed once at create time
+    # (footer parses are a round trip each on object stores)
+    file_sizes: Optional[Tuple[int, ...]] = None
+
+    def materialize(self, files: Optional[Sequence[str]] = None) -> ColumnarBatch:
+        batch = _scan_with_lineage(
+            files if files is not None else self.files,
+            self.fmt,
+            list(self.columns),
+            self.file_ids,
+        )
+        if self.select_cols is not None:
+            batch = batch.select(list(self.select_cols))
+        return batch
+
+    def select(self, cols: Sequence[str]) -> "SourceScan":
+        return dataclasses.replace(self, select_cols=tuple(cols))
+
+
+def per_file_materialized_bytes(files: Sequence[str], fmt: str) -> List[int]:
+    """Per-file rough in-memory size: parquet uncompressed data size from
+    footers; other formats via on-disk size with an expansion factor."""
+    import os
+
+    if fmt in ("parquet", "delta", "iceberg"):
+        import pyarrow.parquet as pq
+
+        def uncompressed(p):
+            md = pq.ParquetFile(p).metadata
+            return sum(
+                md.row_group(i).total_byte_size for i in range(md.num_row_groups)
+            )
+
+        return [uncompressed(f) for f in files]
+    return [os.path.getsize(f) * 2 for f in files]
+
+
+def estimated_materialized_bytes(files: Sequence[str], fmt: str) -> int:
+    return sum(per_file_materialized_bytes(files, fmt))
+
+
+def plan_waves(
+    files: Sequence[str],
+    fmt: str,
+    budget: int,
+    file_sizes: Optional[Sequence[int]] = None,
+) -> List[List[str]]:
+    """Greedy pack files into waves of estimated materialized size <=
+    ``budget`` (always at least one file per wave — a single file larger
+    than the budget still has to be read whole). ``file_sizes`` reuses
+    estimates computed at create time instead of re-parsing footers."""
+    if file_sizes is None:
+        file_sizes = per_file_materialized_bytes(files, fmt)
+    waves: List[List[str]] = []
+    cur: List[str] = []
+    cur_bytes = 0
+    for f, sz in zip(files, file_sizes):
+        if cur and cur_bytes + sz > budget:
+            waves.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(f)
+        cur_bytes += sz
+    if cur:
+        waves.append(cur)
+    return waves
 
 
 def resolve_index_schema(rel, config, properties: Dict[str, str]):
@@ -130,7 +233,6 @@ def create_covering_index(ctx, source_df, config, properties: Dict[str, str]):
         file_ids = {}
         for path, size, mtime in source_file_infos(ctx.session, rel):
             file_ids[path] = ctx.file_id_tracker.add_file(path, size, mtime)
-    batch = _scan_with_lineage(rel.files, rel.fmt, indexed + included, file_ids)
     index = CoveringIndex(
         indexed_columns=indexed,
         included_columns=included,
@@ -138,7 +240,18 @@ def create_covering_index(ctx, source_df, config, properties: Dict[str, str]):
         num_buckets=ctx.session.conf.num_buckets,
         properties=dict(properties),
     )
-    return index, batch
+    budget = ctx.session.conf.build_memory_budget
+    sizes = per_file_materialized_bytes(rel.files, rel.fmt) if budget else None
+    scan = SourceScan(
+        files=tuple(rel.files),
+        fmt=rel.fmt,
+        columns=tuple(indexed + included),
+        file_ids=file_ids,
+        file_sizes=tuple(sizes) if sizes is not None else None,
+    )
+    if budget and sum(sizes) > budget:
+        return index, scan  # streamed at write time (wave loop)
+    return index, scan.materialize()
 
 
 def source_file_infos(session, plan_relation) -> List[Tuple[str, int, int]]:
@@ -215,22 +328,117 @@ def bucketize(ctx, batch: ColumnarBatch, indexed_cols: List[str], num_buckets: i
 
 def write_bucketed(
     ctx,
-    batch: ColumnarBatch,
+    data,
     indexed_cols: List[str],
     num_buckets: int,
     file_idx_offset: int = 0,
 ) -> List[str]:
     """The full build pipeline tail: shuffle, sort-within-bucket, write one
-    parquet per bucket (CoveringIndex.write:56-71 + saveWithBuckets)."""
-    if batch.num_rows == 0:
-        import os
+    parquet per bucket (CoveringIndex.write:56-71 + saveWithBuckets).
 
+    ``data`` is a ColumnarBatch, a :class:`SourceScan` (streamed in waves),
+    or a list mixing both (incremental refresh: appended scan + rewritten
+    old data).
+    """
+    import os
+
+    sources = data if isinstance(data, list) else [data]
+    if any(isinstance(s, SourceScan) for s in sources):
+        return _write_bucketed_streaming(
+            ctx, sources, indexed_cols, num_buckets, file_idx_offset
+        )
+    batch = sources[0] if len(sources) == 1 else ColumnarBatch.concat(sources)
+    if batch.num_rows == 0:
         os.makedirs(ctx.index_data_path, exist_ok=True)
         return []
     buckets, batch = bucketize(ctx, batch, indexed_cols, num_buckets)
     return pio.write_bucket_files(
         ctx.index_data_path, buckets, batch, num_buckets, file_idx_offset
     )
+
+
+def _write_bucketed_streaming(
+    ctx,
+    sources,
+    indexed_cols: List[str],
+    num_buckets: int,
+    file_idx_offset: int = 0,
+) -> List[str]:
+    """The >HBM wave loop (SURVEY §7 hard part #1).
+
+    Bounded peak memory: the build never materializes more than one wave
+    (<= the configured budget) plus, at merge time, one bucket. Phases:
+
+    1. **Waves**: chunk each source's files into waves within the memory
+       budget; per wave, run the normal device pipeline (hash -> all-to-all
+       -> bucket-grouped order) and spill each bucket's run to
+       ``_spill_/b<b>-w<i>.parquet`` (flat, no ``=`` in any path component
+       — Arrow's dataset reader hive-infers partition columns from
+       ``key=value`` directories, which would graft phantom columns onto
+       the merge read).
+    2. **Merge**: per bucket, read that bucket's spilled parts (~1/num_buckets
+       of the data), key-sort on device, write the final bucket file.
+
+    The reference leans on Spark's disk-backed ``repartition`` shuffle for
+    exactly this (covering/CoveringIndex.scala:58-61).
+    """
+    import os
+    import shutil
+
+    budget = ctx.session.conf.build_memory_budget or (1 << 62)
+    # outside the v__=N data dir (also a key=value name) but inside the
+    # index dir; the leading underscore keeps it out of data listings and
+    # the sanitized name keeps "=" out of every spill path component
+    spill_root = os.path.join(
+        os.path.dirname(ctx.index_data_path),
+        "_spill_" + os.path.basename(ctx.index_data_path).replace("=", "_"),
+    )
+    os.makedirs(spill_root, exist_ok=True)
+    wave_idx = 0
+    bucket_parts: Dict[int, List[str]] = {}
+    try:
+        for src in sources:
+            if isinstance(src, SourceScan):
+                waves = plan_waves(
+                    src.files, src.fmt, budget, src.file_sizes
+                )
+                wave_batches = (src.materialize(w) for w in waves)
+            else:
+                wave_batches = iter([src])
+            for batch in wave_batches:
+                if batch.num_rows == 0:
+                    continue
+                buckets, batch = bucketize(
+                    ctx, batch, indexed_cols, num_buckets
+                )
+                table = batch.to_arrow()
+                for b, idx in pio.bucket_runs(buckets):
+                    path = os.path.join(
+                        spill_root, f"b{b:05d}-w{wave_idx:05d}.parquet"
+                    )
+                    pio.write_table(path, table.take(pa.array(idx)))
+                    bucket_parts.setdefault(b, []).append(path)
+                wave_idx += 1
+        # merge: per bucket, read parts, key-sort, write the final file
+        written: List[str] = []
+        for b in sorted(bucket_parts):
+            merged = ColumnarBatch.from_arrow(
+                pio.read_table(bucket_parts[b], None)
+            )
+            perm = sort_permutation(merged.key_reps(indexed_cols))
+            merged = merged.take(perm)
+            written.extend(
+                pio.write_bucket_files(
+                    ctx.index_data_path,
+                    np.full(merged.num_rows, b, dtype=np.int32),
+                    merged,
+                    num_buckets,
+                    file_idx_offset,
+                )
+            )
+        return written
+    finally:
+        shutil.rmtree(spill_root, ignore_errors=True)
 
 
 # ---------------------------------------------------------------------------
@@ -265,15 +473,16 @@ def refresh_incremental(
     schema_cols = list(index.indexed_columns) + list(index.included_columns)
     if index.lineage_enabled:
         schema_cols.append(DATA_FILE_NAME_ID)
-    parts: List[ColumnarBatch] = []
+    # parts: ColumnarBatch or SourceScan (large appends stream in waves)
+    parts: List = []
     if appended_df is not None:
-        _index2, appended_batch = create_covering_index(
+        _index2, appended_data = create_covering_index(
             ctx,
             appended_df,
             _config_of(index),
             dict(index.properties),
         )
-        parts.append(appended_batch.select(schema_cols))
+        parts.append(appended_data.select(schema_cols))
     if deleted_source_file_ids:
         if not index.lineage_enabled:
             raise HyperspaceException(
@@ -291,8 +500,7 @@ def refresh_incremental(
     else:
         mode = UpdateMode.MERGE
     if parts:
-        batch = ColumnarBatch.concat(parts)
-        write_bucketed(ctx, batch, index.indexed_columns, index.num_buckets)
+        write_bucketed(ctx, parts, index.indexed_columns, index.num_buckets)
     return index, mode
 
 

@@ -118,10 +118,14 @@ class ZOrderCoveringIndex(Index):
             schema_cols = schema_cols + [DATA_FILE_NAME_ID]
         parts = []
         if appended_df is not None:
-            _idx, batch = covering_build.create_covering_index(
+            _idx, data = covering_build.create_covering_index(
                 ctx, appended_df, self._config(), dict(self.properties)
             )
-            parts.append(batch.select(schema_cols))
+            # z-order needs the whole delta in memory (global min/max +
+            # total z-sort); the streaming wave loop is covering-index only
+            parts.append(
+                covering_build.materialize_if_scan(data).select(schema_cols)
+            )
         if deleted_source_file_ids:
             if not self.lineage_enabled:
                 raise HyperspaceException(
@@ -151,6 +155,7 @@ class ZOrderCoveringIndex(Index):
         new_index, batch = covering_build.create_covering_index(
             ctx, df, self._config(), dict(self.properties)
         )
+        batch = covering_build.materialize_if_scan(batch)
         # create_covering_index builds a CoveringIndex; re-wrap with our kind
         rebuilt = ZOrderCoveringIndex(
             new_index.indexed_columns,
@@ -247,6 +252,9 @@ class ZOrderCoveringIndexConfig(IndexConfigTrait):
         covering, batch = covering_build.create_covering_index(
             ctx, source_data, self, properties
         )
+        # z-order's global normalization + total sort are not streamed;
+        # materialize even when the covering build would have waved it
+        batch = covering_build.materialize_if_scan(batch)
         index = ZOrderCoveringIndex(
             covering.indexed_columns,
             covering.included_columns,

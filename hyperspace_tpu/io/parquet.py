@@ -107,6 +107,25 @@ def bucket_id_of_file(path: str) -> Optional[int]:
     return int(m.group(1)) if m else None
 
 
+def bucket_runs(bucket_ids: np.ndarray):
+    """Yield ``(bucket_id, row_indices)`` per distinct bucket id.
+
+    bucket_ids need not be globally sorted (shards interleave); runs are
+    found via one stable argsort, and each run's indices are re-sorted
+    ascending so rows keep their (key-sorted) relative order. Shared by
+    the final bucketed write below and the streaming build's spill loop
+    (``indexes/covering_build._write_bucketed_streaming``)."""
+    if len(bucket_ids) == 0:
+        return
+    order = np.argsort(bucket_ids, kind="stable")
+    sorted_ids = bucket_ids[order]
+    boundaries = np.nonzero(np.diff(sorted_ids))[0] + 1
+    starts = np.concatenate([[0], boundaries])
+    ends = np.concatenate([boundaries, [len(sorted_ids)]])
+    for s, e in zip(starts, ends):
+        yield int(sorted_ids[s]), np.sort(order[s:e])
+
+
 def write_bucket_files(
     out_dir: str,
     bucket_ids: np.ndarray,
@@ -119,20 +138,7 @@ def write_bucket_files(
     os.makedirs(out_dir, exist_ok=True)
     table = batch.to_arrow()
     written = []
-    # bucket_ids need not be globally sorted (shards interleave); find runs
-    # per bucket via argsort once.
-    order = np.argsort(bucket_ids, kind="stable")
-    sorted_ids = bucket_ids[order]
-    boundaries = np.nonzero(np.diff(sorted_ids))[0] + 1
-    starts = np.concatenate([[0], boundaries])
-    ends = np.concatenate([boundaries, [len(sorted_ids)]])
-    for s, e in zip(starts, ends):
-        if s == e:
-            continue
-        b = int(sorted_ids[s])
-        idx = order[s:e]
-        # rows within a bucket keep their (key-sorted) relative order
-        idx = np.sort(idx)
+    for b, idx in bucket_runs(bucket_ids):
         path = os.path.join(out_dir, bucket_file_name(file_idx_offset + b, b))
         pq.write_table(table.take(pa.array(idx)), path)
         written.append(path)

@@ -11,9 +11,10 @@ to the device that owns the bucket (``bucket % D``), and exchanges them in
 ONE ``all_to_all`` over the ICI ring. Since XLA programs need static
 shapes, each device sends a fixed-capacity ``[D, n_local]`` buffer per peer
 plus a validity mask; the host compacts valid rows after the exchange.
-(For >HBM datasets the same exchange runs in waves over chunked host
+(For >HBM datasets the same exchange runs once per wave over chunked host
 batches — the reference leans on Spark's disk-backed shuffle for this;
-our wave loop lives in ``indexes/covering_build.py``.)
+our wave loop is ``indexes/covering_build._write_bucketed_streaming``,
+driven by ``hyperspace.index.build.memoryBudgetBytes``.)
 """
 
 from __future__ import annotations
