@@ -64,13 +64,28 @@ def sample_parquet(tmp_path):
     return str(d)
 
 
-@pytest.fixture
-def session(tmp_index_root):
+def _make_session(index_root, n_devices=None):
     from hyperspace_tpu.session import HyperspaceSession
     from hyperspace_tpu import constants as C
 
-    s = HyperspaceSession()
-    s.conf.set(C.INDEX_SYSTEM_PATH, tmp_index_root)
+    devices = jax.devices()[:n_devices] if n_devices is not None else None
+    s = HyperspaceSession(devices=devices)
+    s.conf.set(C.INDEX_SYSTEM_PATH, index_root)
     # Small bucket count for tests (reference tests use 5 shuffle partitions)
     s.conf.set(C.INDEX_NUM_BUCKETS, 8)
     return s
+
+
+@pytest.fixture(params=[1, 8], ids=["mesh1", "mesh8"])
+def session(request, tmp_index_root):
+    """Every session-driven test runs at mesh sizes 1 and 8 — the
+    HybridScanSuite-style matrix (the reference specializes shared
+    scenarios per environment; here the environment axis is the mesh)."""
+    return _make_session(tmp_index_root, request.param)
+
+
+@pytest.fixture
+def session_factory(tmp_index_root):
+    """Build sessions of chosen mesh size over the SAME index system path
+    (cross-mesh layout-compat tests: build at one size, serve at another)."""
+    return lambda n_devices: _make_session(tmp_index_root, n_devices)
