@@ -154,6 +154,13 @@ class Config:
         )
 
     @property
+    def zorder_quantile_relative_error(self) -> float:
+        return self.get_float(
+            C.ZORDER_QUANTILE_RELATIVE_ERROR,
+            C.ZORDER_QUANTILE_RELATIVE_ERROR_DEFAULT,
+        )
+
+    @property
     def dataskipping_target_index_data_file_size(self) -> int:
         return self.get_int(
             C.DATASKIPPING_TARGET_INDEX_DATA_FILE_SIZE,

@@ -18,7 +18,7 @@ import numpy as np
 import pyarrow as pa
 
 from hyperspace_tpu.exceptions import HyperspaceException
-from hyperspace_tpu.io.columnar import Column, ColumnarBatch
+from hyperspace_tpu.io.columnar import Column, ColumnarBatch, column_value_range
 from hyperspace_tpu.ops.bloom import _bit_indices
 from hyperspace_tpu.ops.hash import split_words_np
 from hyperspace_tpu.plan import expressions as E
@@ -39,21 +39,10 @@ def sketch_from_dict(d: dict) -> "Sketch":
     return cls.from_dict(d)
 
 
-def _column_min_max(col: Column):
-    """(min, max) python values of a Column, ignoring nulls; None if all
-    null/empty."""
-    if col.kind == "string":
-        mask = col.codes >= 0
-        if not mask.any():
-            return None, None
-        present = sorted({col.dictionary[c] for c in col.codes[mask]})
-        return present[0], present[-1]
-    v = col.values
-    if col.validity is not None:
-        v = v[col.validity]
-    if len(v) == 0:
-        return None, None
-    return v.min().item(), v.max().item()
+# Shared NaN/null-aware range helper (io/columnar.column_value_range):
+# previously a plain v.min() here let one NaN poison a file's min to NaN,
+# making `min <= lit` False and wrongly skipping a file with matching rows.
+_column_min_max = column_value_range
 
 
 # Col-vs-Lit normalization lives in plan/expressions (shared with the

@@ -193,7 +193,12 @@ def _write_zordered(
     os.makedirs(ctx.index_data_path, exist_ok=True)
     if batch.num_rows == 0:
         return []
-    perm = z_order_permutation([batch.column(c) for c in indexed_cols])
+    conf = ctx.session.conf
+    perm = z_order_permutation(
+        [batch.column(c) for c in indexed_cols],
+        quantile=conf.zorder_quantile_enabled,
+        relative_error=conf.zorder_quantile_relative_error,
+    )
     table = batch.take(perm).to_arrow()
     nbytes = max(table.nbytes, 1)
     num_parts = max(1, math.ceil(nbytes / target_bytes))

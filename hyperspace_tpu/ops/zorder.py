@@ -88,46 +88,83 @@ def _interleave(words, bits: int):
     return planes
 
 
-def z_order_permutation(columns: List, bits: int = 16) -> np.ndarray:
+def _quantile_words_np(
+    enc: np.ndarray, bits: int, relative_error: float
+) -> np.ndarray:
+    """Rank-normalized words: each value maps to its (approximate)
+    quantile bucket on ``bits`` bits — the skew-resistant alternative to
+    min/max scaling (reference: the percentile-based ZOrderField variant,
+    ZOrderField.scala:83+). A deterministic stride sample of size
+    ~1/relative_error² bounds the rank estimation error; equal values
+    always land in the same bucket (searchsorted is value-determined)."""
+    n = len(enc)
+    if n == 0:
+        return np.zeros(0, dtype=np.uint32)
+    top = np.float64((1 << bits) - 1)
+    max_sample = max(int(1.0 / max(relative_error, 1e-4) ** 2), 1024)
+    sample = enc if n <= max_sample else enc[:: max(1, n // max_sample)]
+    s = np.sort(sample)
+    pos = np.searchsorted(s, enc, side="right").astype(np.float64)
+    return ((pos / max(len(s), 1)) * top).astype(np.uint32)
+
+
+def z_order_permutation(
+    columns: List,
+    bits: int = 16,
+    quantile: bool = False,
+    relative_error: float = 0.01,
+) -> np.ndarray:
     """Sort permutation by z-address over the given Columns
     (the build-side replacement for repartitionByRange on ``_zaddr``,
-    ZOrderCoveringIndex.scala:97-154)."""
+    ZOrderCoveringIndex.scala:97-154). ``quantile=True`` switches from
+    min/max scaling to quantile-bucket encoding (skewed columns keep
+    using all address bits instead of collapsing onto a few)."""
     from hyperspace_tpu.ops import pad_len
 
     encs = [order_u64_np(c) for c in columns]
-    mins = [e.min() if len(e) else np.uint64(0) for e in encs]
-    maxs = [e.max() if len(e) else np.uint64(0) for e in encs]
     n = len(encs[0]) if encs else 0
     n_pad = pad_len(max(n, 1))
-    if n_pad != n:
-        # pad rows encode as the max z-address and sort last (shape policy;
-        # lexsort_perm slices them off)
-        encs = [
-            np.concatenate(
-                [e, np.full(n_pad - n, np.uint64(0xFFFFFFFFFFFFFFFF))]
-            )
-            for e in encs
-        ]
-    enc_hi = np.stack([(e >> np.uint64(32)).astype(np.uint32) for e in encs])
-    enc_lo = np.stack([(e & np.uint64(0xFFFFFFFF)).astype(np.uint32) for e in encs])
-    mins_hi = np.array(
-        [(m >> np.uint64(32)) for m in mins], dtype=np.uint32
-    )[:, None]
-    mins_lo = np.array(
-        [(m & np.uint64(0xFFFFFFFF)) for m in mins], dtype=np.uint32
-    )[:, None]
-    ranges = np.array(
-        [float(int(mx) - int(mn)) for mn, mx in zip(mins, maxs)],
-        dtype=np.float64,
-    )[:, None]
-    words = _normalize(
-        jnp.asarray(enc_hi),
-        jnp.asarray(enc_lo),
-        jnp.asarray(mins_hi),
-        jnp.asarray(mins_lo),
-        jnp.asarray(ranges),
-        bits,
-    )
+    if quantile:
+        word_rows = [_quantile_words_np(e, bits, relative_error) for e in encs]
+        if n_pad != n:
+            # pad rows take the max word so they sort last (shape policy)
+            fill = np.full(n_pad - n, np.uint32((1 << bits) - 1))
+            word_rows = [np.concatenate([w, fill]) for w in word_rows]
+        words = jnp.asarray(np.stack(word_rows))
+    else:
+        mins = [e.min() if len(e) else np.uint64(0) for e in encs]
+        maxs = [e.max() if len(e) else np.uint64(0) for e in encs]
+        if n_pad != n:
+            # pad rows encode as the max z-address and sort last (shape
+            # policy; lexsort_perm slices them off)
+            encs = [
+                np.concatenate(
+                    [e, np.full(n_pad - n, np.uint64(0xFFFFFFFFFFFFFFFF))]
+                )
+                for e in encs
+            ]
+        enc_hi = np.stack([(e >> np.uint64(32)).astype(np.uint32) for e in encs])
+        enc_lo = np.stack(
+            [(e & np.uint64(0xFFFFFFFF)).astype(np.uint32) for e in encs]
+        )
+        mins_hi = np.array(
+            [(m >> np.uint64(32)) for m in mins], dtype=np.uint32
+        )[:, None]
+        mins_lo = np.array(
+            [(m & np.uint64(0xFFFFFFFF)) for m in mins], dtype=np.uint32
+        )[:, None]
+        ranges = np.array(
+            [float(int(mx) - int(mn)) for mn, mx in zip(mins, maxs)],
+            dtype=np.float64,
+        )[:, None]
+        words = _normalize(
+            jnp.asarray(enc_hi),
+            jnp.asarray(enc_lo),
+            jnp.asarray(mins_hi),
+            jnp.asarray(mins_lo),
+            jnp.asarray(ranges),
+            bits,
+        )
     planes = _interleave(words, bits)
     from hyperspace_tpu.ops.sort import lexsort_perm
 

@@ -1,0 +1,210 @@
+"""Min/max layout-quality analysis.
+
+TPU-native port of the reference's ``util/MinMaxAnalysisUtil.scala:30-780``:
+for each requested column, collect per-FILE min/max, then measure how many
+files a point lookup on that column would have to touch — the figure of
+merit for physical layout quality (z-ordering, clustering, partitioning).
+A perfectly clustered column touches 1 file per point lookup; a randomly
+laid-out column touches all of them.
+
+The reference line-sweeps start/end markers with Catalyst orderings and
+renders an ASCII histogram; here the sweep is vectorized numpy over the
+per-file [min, max] intervals (closed-interval overlap, ties inclusive —
+matching the reference's start-before-end tie sort). Non-numeric columns
+are skipped with a note, like the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.io import parquet as pio
+from hyperspace_tpu.plan.nodes import Scan
+
+
+@dataclasses.dataclass
+class MinMaxColumnResult:
+    column: str
+    min_val: Optional[float]
+    max_val: Optional[float]
+    total_files: int
+    total_bytes: int
+    # per value-bin: number of files whose [min,max] intersects the bin
+    bin_file_counts: List[int]
+    max_files_per_lookup: int  # exact (computed at interval endpoints)
+    avg_files_per_lookup: float
+    max_bytes_per_lookup: int
+
+    def to_text(self) -> str:
+        lines = [f"Column: {self.column}"]
+        if self.min_val is None:
+            lines += [
+                "  all values null",
+                f"  Total num of files: {self.total_files}",
+                f"  Total byte size of files: {self.total_bytes}",
+            ]
+            return "\n".join(lines)
+        pct_max = 100.0 * self.max_files_per_lookup / max(self.total_files, 1)
+        pct_avg = 100.0 * self.avg_files_per_lookup / max(self.total_files, 1)
+        pct_bytes = 100.0 * self.max_bytes_per_lookup / max(self.total_bytes, 1)
+        lines += [
+            f"  min: {self.min_val}  max: {self.max_val}",
+            f"  Total num of files: {self.total_files}",
+            f"  Total byte size of files: {self.total_bytes}",
+            f"  Max files for a point lookup: {self.max_files_per_lookup}"
+            f" ({pct_max:.2f}%)",
+            f"  Avg files for a point lookup: {self.avg_files_per_lookup:.2f}"
+            f" ({pct_avg:.2f}%)",
+            f"  Max bytes for a point lookup: {self.max_bytes_per_lookup}"
+            f" ({pct_bytes:.2f}%)",
+        ]
+        if self.bin_file_counts:
+            peak = max(self.bin_file_counts) or 1
+            width = 40
+            lines.append("  files touched per value range:")
+            for i, c in enumerate(self.bin_file_counts):
+                bar = "#" * max(1 if c else 0, round(width * c / peak))
+                lines.append(f"  [{i:3d}] {c:6d} |{bar}")
+        return "\n".join(lines)
+
+
+def _norm(x) -> float:
+    """Finite float image of a column value (NaN never reaches here —
+    column_value_range excludes NaN rows, matching engine comparison
+    semantics)."""
+    f = float(x)
+    if np.isposinf(f):
+        return float(np.finfo(np.float64).max)
+    if np.isneginf(f):
+        return float(np.finfo(np.float64).min)
+    return 0.0 if f == 0.0 else f
+
+
+def _is_numeric_like(t: pa.DataType) -> bool:
+    return (
+        pa.types.is_integer(t)
+        or pa.types.is_floating(t)
+        or pa.types.is_boolean(t)
+        or pa.types.is_temporal(t)
+    )
+
+
+def analyze_column(
+    column: str,
+    intervals: List[Tuple[float, float]],
+    sizes: List[int],
+    total_files: int,
+    total_bytes: int,
+    num_bins: int = 50,
+) -> MinMaxColumnResult:
+    """Overlap analysis over per-file [min,max] intervals (all-null files
+    excluded by the caller)."""
+    if not intervals:
+        return MinMaxColumnResult(
+            column, None, None, total_files, total_bytes, [], 0, 0.0, 0
+        )
+    lo = np.array([a for a, _ in intervals])
+    hi = np.array([b for _, b in intervals])
+    sz = np.array(sizes, dtype=np.int64)
+    vmin, vmax = float(lo.min()), float(hi.max())
+    # exact max overlap via an O(F log F) line sweep (the reference's
+    # start/end marker sort): +1 at each min, -1 after each max; at equal
+    # coordinates starts process first so closed intervals sharing an
+    # endpoint both count (reference tie order: start before end).
+    coords = np.concatenate([lo, hi])
+    kinds = np.concatenate(
+        [np.zeros(len(lo), np.int8), np.ones(len(hi), np.int8)]
+    )
+    deltas = np.concatenate([np.ones(len(lo), np.int64), -np.ones(len(hi), np.int64)])
+    byte_deltas = np.concatenate([sz, -sz])
+    order = np.lexsort((kinds, coords))
+    max_files = int(np.cumsum(deltas[order]).max())
+    max_bytes = int(np.cumsum(byte_deltas[order]).max())
+    # value-range histogram: bin overlap counts (display + avg)
+    if vmax > vmin:
+        edges = np.linspace(vmin, vmax, num_bins + 1)
+        starts, ends = edges[:-1], edges[1:]
+        overlap = (lo[None, :] <= ends[:, None]) & (starts[:, None] <= hi[None, :])
+        counts = overlap.sum(axis=1).astype(int).tolist()
+    else:
+        counts = [len(intervals)]
+    nonzero = [c for c in counts if c > 0]
+    avg = float(sum(nonzero) / len(nonzero)) if nonzero else 0.0
+    return MinMaxColumnResult(
+        column,
+        vmin,
+        vmax,
+        total_files,
+        total_bytes,
+        counts,
+        max_files,
+        avg,
+        max_bytes,
+    )
+
+
+def analyze_min_max(
+    df, columns: Sequence[str], num_bins: int = 50
+) -> List[MinMaxColumnResult]:
+    """Per-column layout analysis of a DataFrame's underlying files
+    (reference: ``MinMaxAnalysisUtil.analyze(df, cols)``)."""
+    leaves = [p for p in df.logical_plan.collect_leaves() if isinstance(p, Scan)]
+    if len(leaves) != 1:
+        raise HyperspaceException(
+            "min/max analysis needs a single-relation DataFrame"
+        )
+    from hyperspace_tpu.io.columnar import Column, column_value_range
+
+    rel = leaves[0].relation
+    schema = rel.schema
+    file_sizes = {f: os.path.getsize(f) for f in rel.files}
+    total_bytes = sum(file_sizes.values())
+    for c in columns:
+        if c not in rel.column_names:
+            raise HyperspaceException(f"No such column {c!r}")
+    numeric_cols = [c for c in columns if _is_numeric_like(schema[c])]
+    # one read per file for ALL analyzed columns (not per column)
+    ranges: Dict[str, List[Tuple[float, float]]] = {c: [] for c in numeric_cols}
+    sizes: Dict[str, List[int]] = {c: [] for c in numeric_cols}
+    if numeric_cols:
+        for f in rel.files:
+            t = pio.read_table([f], numeric_cols, rel.fmt)
+            for c in numeric_cols:
+                lo, hi = column_value_range(Column.from_arrow(t.column(c)))
+                if lo is None:
+                    continue  # all null/NaN in this file
+                ranges[c].append((_norm(lo), _norm(hi)))
+                sizes[c].append(file_sizes[f])
+    results = []
+    for c in columns:
+        if c not in ranges:
+            results.append(
+                MinMaxColumnResult(
+                    c + " (skipped: non-numeric)",
+                    None,
+                    None,
+                    len(rel.files),
+                    total_bytes,
+                    [],
+                    0,
+                    0.0,
+                    0,
+                )
+            )
+            continue
+        results.append(
+            analyze_column(
+                c, ranges[c], sizes[c], len(rel.files), total_bytes, num_bins
+            )
+        )
+    return results
+
+
+def analyze_min_max_string(df, columns: Sequence[str], num_bins: int = 50) -> str:
+    return "\n\n".join(r.to_text() for r in analyze_min_max(df, columns, num_bins))

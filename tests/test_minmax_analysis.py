@@ -1,0 +1,149 @@
+"""MinMax layout analysis + quantile z-order.
+
+Covers the reference's ``MinMaxAnalysisUtil`` behavior (per-file min/max
+overlap analysis as the layout-quality metric) and uses it the way the
+reference intends: to show that percentile-based z-order encoding
+(``ZOrderField.scala:83+``) beats min/max encoding on skewed columns.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.plananalysis.minmax_analysis import (
+    analyze_column,
+    analyze_min_max,
+    analyze_min_max_string,
+)
+
+
+class TestAnalyzeColumn:
+    def test_disjoint_intervals_touch_one_file(self):
+        res = analyze_column(
+            "c", [(0, 9), (10, 19), (20, 29)], [100, 100, 100], 3, 300
+        )
+        assert res.max_files_per_lookup == 1
+        assert res.max_bytes_per_lookup == 100
+
+    def test_identical_intervals_touch_all(self):
+        res = analyze_column("c", [(0, 10)] * 4, [50] * 4, 4, 200)
+        assert res.max_files_per_lookup == 4
+        assert res.max_bytes_per_lookup == 200
+
+    def test_shared_endpoint_counts_both(self):
+        # closed intervals: a lookup at 10 touches both files
+        res = analyze_column("c", [(0, 10), (10, 20)], [1, 1], 2, 2)
+        assert res.max_files_per_lookup == 2
+
+    def test_all_null(self):
+        res = analyze_column("c", [], [], 3, 300)
+        assert res.min_val is None
+        assert "null" in res.to_text()
+
+    def test_nan_rows_do_not_poison_file_range(self, session, tmp_path):
+        d = tmp_path / "nan"
+        d.mkdir()
+        pq.write_table(
+            pa.table({"x": pa.array([1.0, 2.0, float("nan")])}),
+            d / "a.parquet",
+        )
+        pq.write_table(
+            pa.table({"x": pa.array([1.5, 3.0])}), d / "b.parquet"
+        )
+        df = session.read.parquet(str(d))
+        (res,) = analyze_min_max(df, ["x"])
+        # a lookup at 1.5 must count BOTH files (the NaN file really
+        # contains 1.0..2.0); before the nan-aware range it reported a
+        # [FLOAT_MAX, FLOAT_MAX] interval for file a
+        assert res.max_files_per_lookup == 2
+        assert res.min_val == 1.0 and res.max_val == 3.0
+
+
+class TestAnalyzeDataFrame:
+    def test_clustered_vs_random_layout(self, session, tmp_path):
+        rng = np.random.default_rng(2)
+        d = tmp_path / "lay"
+        d.mkdir()
+        vals = np.arange(4000)
+        rand = rng.permutation(vals)
+        for i in range(8):
+            sl = slice(i * 500, (i + 1) * 500)
+            pq.write_table(
+                pa.table(
+                    {
+                        "clustered": pa.array(vals[sl], type=pa.int64()),
+                        "random": pa.array(rand[sl], type=pa.int64()),
+                        "name": pa.array([f"r{j}" for j in range(500)]),
+                    }
+                ),
+                d / f"f{i}.parquet",
+            )
+        df = session.read.parquet(str(d))
+        res = {r.column: r for r in analyze_min_max(df, ["clustered", "random"])}
+        assert res["clustered"].max_files_per_lookup == 1
+        assert res["random"].max_files_per_lookup == 8
+        assert res["clustered"].avg_files_per_lookup < (
+            res["random"].avg_files_per_lookup
+        )
+        text = analyze_min_max_string(df, ["clustered", "name"])
+        assert "Max files for a point lookup: 1" in text
+        assert "non-numeric" in text
+
+
+@pytest.mark.parametrize("session", [8], indirect=True)
+class TestQuantileZOrder:
+    def _build_and_measure(self, session, tmp_path, src, quantile, name):
+        from hyperspace_tpu.indexes.zorder import ZOrderCoveringIndexConfig
+
+        hs = Hyperspace(session)
+        session.conf.set(C.ZORDER_QUANTILE_ENABLED, quantile)
+        # small target so the z-sorted index splits into many files
+        session.conf.set(C.ZORDER_TARGET_SOURCE_BYTES_PER_PARTITION, 8_000)
+        df = session.read.parquet(src)
+        hs.create_index(df, ZOrderCoveringIndexConfig(name, ["skewed", "uniform"]))
+        entry = session.index_manager.get_index_log_entry(name)
+        files = list(entry.content.files)
+        assert len(files) > 4, "need a multi-file layout to measure"
+        import os
+
+        idx_df = session.read.parquet(os.path.dirname(files[0]))
+        (res,) = analyze_min_max(idx_df, ["skewed"])
+        return res
+
+    def test_quantile_beats_minmax_on_skew(self, session, tmp_path):
+        """99% of values live in [0, 1000); outliers reach 1e12. Min/max
+        scaling collapses the dense region onto one z-word, so z-order
+        degenerates and point lookups touch many files; quantile encoding
+        keeps the address bits busy and lookups touch few."""
+        rng = np.random.default_rng(7)
+        d = tmp_path / "skew"
+        d.mkdir()
+        n = 8000
+        dense = rng.integers(0, 1000, n, dtype=np.int64)
+        outlier_at = rng.random(n) < 0.01
+        skewed = np.where(
+            outlier_at, rng.integers(1, 10**12, n, dtype=np.int64), dense
+        )
+        t = pa.table(
+            {
+                "skewed": pa.array(skewed, type=pa.int64()),
+                "uniform": pa.array(
+                    rng.integers(0, 10**6, n, dtype=np.int64)
+                ),
+            }
+        )
+        for i in range(4):
+            pq.write_table(t.slice(i * (n // 4), n // 4), d / f"p{i}.parquet")
+
+        mm = self._build_and_measure(session, tmp_path, str(d), False, "z_mm")
+        qt = self._build_and_measure(session, tmp_path, str(d), True, "z_qt")
+        # min/max scaling degenerates: every file spans the dense region,
+        # so a point lookup there touches ALL files; quantile stays local.
+        # (The per-bin avg is not comparable here: equal-width bins over
+        # the outlier range hide the dense region, so assert on the exact
+        # point-lookup maximum.)
+        assert mm.max_files_per_lookup == mm.total_files
+        assert qt.max_files_per_lookup < mm.max_files_per_lookup

@@ -133,6 +133,32 @@ class TestBooleanAggregates:
         assert out.column("hi").to_pylist() == [True]
 
 
+class TestNaNMinMaxSketch:
+    def test_nan_does_not_skip_matching_file(self, session, tmp_path):
+        """A NaN in a float column must not poison the file's min/max
+        sketch (plain min() returns NaN, making `min <= lit` False and
+        wrongly pruning a file that has matching rows)."""
+        from hyperspace_tpu.hyperspace import Hyperspace
+        from hyperspace_tpu.indexes.dataskipping import DataSkippingIndexConfig
+        from hyperspace_tpu.indexes.sketches import MinMaxSketch
+
+        d = tmp_path / "nansketch"
+        d.mkdir()
+        pq.write_table(
+            pa.table({"x": pa.array([1.0, 2.0, float("nan")])}),
+            d / "a.parquet",
+        )
+        pq.write_table(
+            pa.table({"x": pa.array([100.0, 200.0])}), d / "b.parquet"
+        )
+        hs = Hyperspace(session)
+        df = session.read.parquet(str(d))
+        hs.create_index(df, DataSkippingIndexConfig("nsk", MinMaxSketch("x")))
+        session.enable_hyperspace()
+        out = df.filter(df["x"] <= 2.0).select("x").collect()
+        assert sorted(out.column("x").to_pylist()) == [1.0, 2.0]
+
+
 class TestLimitPushdown:
     def test_limit_reads_only_needed_files(self, session, tmp_path, monkeypatch):
         t = pa.table({"x": pa.array(np.arange(1000), type=pa.int64())})
