@@ -114,6 +114,25 @@ class MinMaxSketch(Sketch):
             f"MinMax_{self.column}__max": hi,
         }
 
+    def _probe_literal(self, lit):
+        """Literal as a python value comparable to the stored sketch cells
+        (temporal literals parse through the recorded source type)."""
+        if self.source_type is None:
+            return lit
+        import pyarrow as pa
+
+        from hyperspace_tpu.rules.rule_utils import parse_arrow_type
+
+        try:
+            t = parse_arrow_type(self.source_type)
+        except Exception:
+            return lit
+        if not pa.types.is_temporal(t):
+            return lit
+        # stored cells are python date/datetime (to_pylist); normalize the
+        # probe literal to the same domain
+        return E.normalize_temporal_literal(lit, t)
+
     def convert_predicate(self, expr, table):
         lo_name = f"MinMax_{self.column}__min"
         if lo_name not in table.column_names:
@@ -125,6 +144,9 @@ class MinMaxSketch(Sketch):
         valid = np.array([x is not None for x in lo])
 
         def cmp(op, lit):
+            lit = self._probe_literal(lit)
+            if lit is None:
+                raise TypeError("unrepresentable probe literal")
             out = np.zeros(len(lo), dtype=bool)
             for i in range(len(lo)):
                 if not valid[i]:

@@ -98,7 +98,16 @@ class _Prep:
                         self._arg(np.int64(lo)),
                         self._arg(np.int64(hi)),
                     )
-                return ("cmp_lit", op, cspec, self._arg(np.asarray(right.value)))
+                lit = E.lower_literal(
+                    right.value, self.batch.column(left.name).arrow_type
+                )
+                if lit is None:
+                    # unrepresentable: = / orderings never true; != true
+                    # for every non-null row (NOT IS NULL)
+                    if op == "!=":
+                        return ("not", ("isnull", cspec))
+                    return ("const", False)
+                return ("cmp_lit", op, cspec, self._arg(np.asarray(lit)))
             if isinstance(left, E.Col) and isinstance(right, E.Col):
                 lspec, lref = self._col(left.name)
                 rspec, rref = self._col(right.name)
@@ -139,8 +148,11 @@ class _Prep:
                         ranks.append(lo)
                 arr = np.array(sorted(ranks) or [-1], dtype=np.int64)
             else:
-                # type-compatible literals only (host path does the same)
-                lits = [v for v in vals if isinstance(v, (int, float, bool))]
+                # shared lowering with the host path (E.lower_in_literals)
+                # so device and host IN agree on temporal/typed literals
+                lits = E.lower_in_literals(
+                    vals, self.batch.column(e.child.name).arrow_type
+                )
                 if not lits:
                     return ("const", False)
                 arr = np.sort(np.array(lits))

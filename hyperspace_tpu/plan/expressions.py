@@ -215,6 +215,111 @@ def conjunction(exprs: List[Expr]) -> Optional[Expr]:
     return out
 
 
+def lower_literal(value, arrow_type):
+    """Engine-internal image of a literal for a column of ``arrow_type``.
+
+    Temporal columns are stored as int64 epoch units (io/columnar ingest
+    views datetime64 as int64), so temporal literals — np.datetime64,
+    datetime.date/datetime, ISO strings — are lowered through the same
+    arrow ingestion path the data took, landing in the column's exact
+    unit. Non-temporal types pass through unchanged. Returns None when
+    the literal cannot represent a value of the column's type (a
+    comparison against it can then never be true).
+    """
+    import pyarrow as pa
+
+    if arrow_type is None or not pa.types.is_temporal(arrow_type):
+        return value
+    unit = _temporal_storage_unit(arrow_type)
+    if unit is None:
+        return value  # time/duration types: untouched (pre-existing path)
+    dt64 = _as_datetime64(value)
+    if dt64 is None:
+        return None
+    conv = dt64.astype(f"datetime64[{unit}]")
+    if conv.astype(dt64.dtype) != dt64:
+        return None  # lossy (e.g. ns-precision literal vs µs column)
+    return np.int64(conv.view("int64"))
+
+
+def _temporal_storage_unit(arrow_type):
+    """numpy datetime64 unit matching io/columnar's int64 storage of the
+    arrow type (date32→days, date64→ms, timestamp→its own unit)."""
+    import pyarrow as pa
+
+    if pa.types.is_date32(arrow_type):
+        return "D"
+    if pa.types.is_date64(arrow_type):
+        return "ms"
+    if pa.types.is_timestamp(arrow_type):
+        return arrow_type.unit
+    return None
+
+
+def _as_datetime64(value):
+    """np.datetime64 image of a literal at its OWN precision (so lossy
+    conversions are detectable), or None."""
+    import datetime as _dt
+
+    if isinstance(value, np.datetime64):
+        return value
+    if isinstance(value, str):
+        try:
+            return np.datetime64(value)
+        except ValueError:
+            return None
+    if isinstance(value, _dt.datetime):
+        return np.datetime64(value, "us")
+    if isinstance(value, _dt.date):
+        return np.datetime64(value, "D")
+    return None
+
+
+def normalize_temporal_literal(value, arrow_type):
+    """Python date/datetime image of a temporal literal, or None when
+    unrepresentable — for consumers comparing against python-object cells
+    (the min/max sketch probe). A sub-day instant can never represent a
+    date; sub-microsecond precision cannot round-trip through python
+    datetime, so such literals return None (callers fall back to no
+    pruning, which is sound)."""
+    import datetime as _dt
+
+    import pyarrow as pa
+
+    dt64 = _as_datetime64(value)
+    if dt64 is None:
+        return None
+    us = dt64.astype("datetime64[us]")
+    if us.astype(dt64.dtype) != dt64:
+        return None
+    value = us.item()  # datetime.datetime
+    if pa.types.is_date(arrow_type):
+        if value.time() != _dt.time(0):
+            return None
+        value = value.date()
+    return value
+
+
+def lower_in_literals(values, arrow_type) -> List[Any]:
+    """IN-list literals in engine-internal form for a numeric column:
+    temporal literals lower to the column's int64 units (unrepresentable
+    ones can never match and are dropped); otherwise only type-compatible
+    plain literals survive. Shared by the host evaluator and the device
+    filter so both paths agree."""
+    import pyarrow as pa
+
+    if arrow_type is not None and pa.types.is_temporal(arrow_type):
+        out = []
+        for v in values:
+            if v is None:
+                continue
+            lv = lower_literal(v, arrow_type)
+            if lv is not None:
+                out.append(lv)
+        return out
+    return [v for v in values if isinstance(v, (int, float, bool))]
+
+
 def normalize_comparison(expr: Expr) -> Optional[Tuple[str, str, Any]]:
     """-> (op, column_name, literal) for Col-vs-Lit comparisons (either
     operand order; never a None literal), else None. The single home of
@@ -335,6 +440,12 @@ def _cmp(expr: Expr, batch, op_name: str) -> Tuple[np.ndarray, Optional[np.ndarr
             r = vref.rank_values()
             vals = {"<": r < lo, "<=": r < hi, ">": r >= hi, ">=": r >= lo}[op_name]
             return vals, vref.valid
+        lit = lower_literal(lit, batch.column(left.name).arrow_type)
+        if lit is None:
+            # literal unrepresentable in the column's type: equality and
+            # orderings can never hold; != holds for every non-null row
+            n = batch.num_rows
+            return np.full(n, op_name == "!="), valid
         v = vref
         with np.errstate(invalid="ignore"):
             vals = {
@@ -437,10 +548,12 @@ def evaluate(expr: Expr, batch) -> Tuple[np.ndarray, Optional[np.ndarray]]:
             codes.discard(-2)
             vals = np.isin(vref.codes, np.array(sorted(codes), dtype=np.int64))
             return vals, vref.valid
-        # keep only type-compatible literals: 5 matches isin(5, "a") on an
-        # int column; the string literal can never match and must not
-        # poison the comparison dtype (bool counts as numeric: flag.isin(True))
-        lits = [v for v in expr.values if isinstance(v, (int, float, bool))]
+        # type-compatible literals only: 5 matches isin(5, "a") on an int
+        # column, the string can never match and must not poison the
+        # comparison dtype; temporal literals lower to int64 units
+        lits = lower_in_literals(
+            expr.values, batch.column(expr.child.name).arrow_type
+        )
         if not lits:
             return np.zeros(n, bool), valid
         vals = np.isin(vref, np.array(lits))

@@ -74,6 +74,54 @@ class MinMaxColumnResult:
         return "\n".join(lines)
 
 
+def _stat_to_float(v) -> float:
+    """Float image of a parquet-statistics value (logical types arrive as
+    python date/datetime objects). Scale only needs to be consistent
+    WITHIN a column: footer and data paths are never mixed per column."""
+    import datetime as _dt
+
+    if isinstance(v, _dt.datetime):
+        return float(np.datetime64(v, "us").view("int64"))
+    if isinstance(v, _dt.date):
+        return float(np.datetime64(v, "D").view("int64"))
+    return _norm(v)
+
+
+def _footer_ranges(files, column: str):
+    """Per-file (lo, hi) from parquet row-group statistics, or None when
+    any file lacks min/max stats for the column (caller falls back to a
+    data read for the whole column — scales must not mix). Entries are
+    None for all-null files."""
+    import pyarrow.parquet as pq
+
+    out = []
+    for f in files:
+        md = pq.ParquetFile(f).metadata
+        lo = hi = None
+        for rg in range(md.num_row_groups):
+            row_group = md.row_group(rg)
+            cc = None
+            for ci in range(row_group.num_columns):
+                c = row_group.column(ci)
+                if c.path_in_schema == column:
+                    cc = c
+                    break
+            if cc is None:
+                return None
+            st = cc.statistics
+            if st is None or not st.has_min_max:
+                if cc.num_values == 0 or (
+                    st is not None and st.null_count == row_group.num_rows
+                ):
+                    continue  # empty / all-null row group
+                return None
+            mn, mx = _stat_to_float(st.min), _stat_to_float(st.max)
+            lo = mn if lo is None else min(lo, mn)
+            hi = mx if hi is None else max(hi, mx)
+        out.append(None if lo is None else (lo, hi))
+    return out
+
+
 def _norm(x) -> float:
     """Finite float image of a column value (NaN never reaches here —
     column_value_range excludes NaN rows, matching engine comparison
@@ -169,13 +217,31 @@ def analyze_min_max(
         if c not in rel.column_names:
             raise HyperspaceException(f"No such column {c!r}")
     numeric_cols = [c for c in columns if _is_numeric_like(schema[c])]
-    # one read per file for ALL analyzed columns (not per column)
     ranges: Dict[str, List[Tuple[float, float]]] = {c: [] for c in numeric_cols}
     sizes: Dict[str, List[int]] = {c: [] for c in numeric_cols}
-    if numeric_cols:
+    # footer-statistics fast path (no data read) for non-float columns of
+    # parquet-like sources; floats need the NaN-aware data read (parquet
+    # float stats are writer-dependent around NaN)
+    data_cols = []
+    for c in numeric_cols:
+        footer = None
+        if rel.fmt in ("parquet", "delta", "iceberg") and not (
+            pa.types.is_floating(schema[c])
+        ):
+            footer = _footer_ranges(rel.files, c)
+        if footer is None:
+            data_cols.append(c)
+            continue
+        for f, rng in zip(rel.files, footer):
+            if rng is None:
+                continue  # all-null file
+            ranges[c].append(rng)
+            sizes[c].append(file_sizes[f])
+    # one read per file for the remaining columns (not one per column)
+    if data_cols:
         for f in rel.files:
-            t = pio.read_table([f], numeric_cols, rel.fmt)
-            for c in numeric_cols:
+            t = pio.read_table([f], data_cols, rel.fmt)
+            for c in data_cols:
                 lo, hi = column_value_range(Column.from_arrow(t.column(c)))
                 if lo is None:
                     continue  # all null/NaN in this file

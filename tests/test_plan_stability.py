@@ -1,0 +1,203 @@
+"""TPC-H-mini plan-stability golden harness.
+
+The reference checks in 103 TPC-DS queries and snapshots their simplified
+physical plans, failing CI on any plan change
+(``goldstandard/PlanStabilitySuite.scala:46-290``). Same idea here at
+TPC-H-mini scale: a deterministic generated dataset, a fixed index
+inventory, and golden *simplified optimized plans* (paths and log versions
+normalized) checked into ``tests/goldstandard/``.
+
+Regenerate after an intentional planner change with:
+
+    HS_GENERATE_GOLDEN_FILES=1 python -m pytest tests/test_plan_stability.py
+
+and review the diff like the reference's SPARK_GENERATE_GOLDEN_FILES flow.
+"""
+
+import os
+import re
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu import functions as F
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+from hyperspace_tpu.indexes.dataskipping import DataSkippingIndexConfig
+from hyperspace_tpu.indexes.sketches import MinMaxSketch
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "goldstandard")
+GENERATE = os.environ.get("HS_GENERATE_GOLDEN_FILES") == "1"
+
+
+def _gen_tpch_mini(root):
+    """Deterministic TPC-H-shaped tables (SF ~0.001)."""
+    rng = np.random.default_rng(1994)
+    n_l, n_o, n_c = 2000, 400, 100
+    base = np.datetime64("1994-01-01")
+    lineitem = pa.table(
+        {
+            "l_orderkey": pa.array(
+                rng.integers(0, n_o, n_l), type=pa.int64()
+            ),
+            "l_quantity": pa.array(
+                rng.integers(1, 51, n_l), type=pa.int64()
+            ),
+            "l_extendedprice": pa.array(rng.normal(30000, 8000, n_l)),
+            "l_shipdate": pa.array(
+                (base + rng.integers(0, 1200, n_l).astype("timedelta64[D]"))
+                .astype("datetime64[D]")
+            ),
+        }
+    )
+    orders = pa.table(
+        {
+            "o_orderkey": pa.array(np.arange(n_o), type=pa.int64()),
+            "o_custkey": pa.array(
+                rng.integers(0, n_c, n_o), type=pa.int64()
+            ),
+            "o_totalprice": pa.array(rng.normal(150000, 30000, n_o)),
+        }
+    )
+    customer = pa.table(
+        {
+            "c_custkey": pa.array(np.arange(n_c), type=pa.int64()),
+            "c_mktsegment": pa.array(
+                [["BUILDING", "MACHINERY", "AUTOMOBILE"][i % 3] for i in range(n_c)]
+            ),
+        }
+    )
+    for name, table, parts in (
+        ("lineitem", lineitem, 4),
+        ("orders", orders, 2),
+        ("customer", customer, 1),
+    ):
+        d = os.path.join(root, name)
+        os.makedirs(d)
+        rows = table.num_rows
+        for i in range(parts):
+            lo, hi = i * rows // parts, (i + 1) * rows // parts
+            pq.write_table(table.slice(lo, hi - lo), os.path.join(d, f"part-{i}.parquet"))
+
+
+@pytest.fixture
+def tpch(session, tmp_path):
+    root = str(tmp_path / "tpch")
+    os.makedirs(root)
+    _gen_tpch_mini(root)
+    hs = Hyperspace(session)
+    read = lambda t: session.read.parquet(os.path.join(root, t))
+    li, od, cu = read("lineitem"), read("orders"), read("customer")
+    hs.create_index(
+        li,
+        CoveringIndexConfig(
+            "li_okey", ["l_orderkey"], ["l_quantity", "l_extendedprice"]
+        ),
+    )
+    hs.create_index(od, CoveringIndexConfig("od_okey", ["o_orderkey"], ["o_custkey"]))
+    hs.create_index(cu, CoveringIndexConfig("cu_ckey", ["c_custkey"], ["c_mktsegment"]))
+    hs.create_index(
+        li, DataSkippingIndexConfig("li_ship_sk", MinMaxSketch("l_shipdate"))
+    )
+    session.enable_hyperspace()
+    session.conf.set(C.INDEX_FILTER_RULE_USE_BUCKET_SPEC, True)
+    return {"lineitem": li, "orders": od, "customer": cu, "root": root}
+
+
+def _queries(t):
+    li, od, cu = t["lineitem"], t["orders"], t["customer"]
+    return {
+        # point filter on the covering index's first indexed column
+        "q01_point_filter": li.filter(li["l_orderkey"] == 42).select(
+            "l_orderkey", "l_quantity"
+        ),
+        # range filter served by the data-skipping sketch
+        "q02_range_skip": li.filter(
+            li["l_shipdate"] >= np.datetime64("1996-06-01")
+        ).select("l_shipdate", "l_quantity"),
+        # co-bucketed indexed join
+        "q03_join": od.join(li, on=od["o_orderkey"] == li["l_orderkey"]).select(
+            "o_orderkey", "o_custkey", "l_quantity"
+        ),
+        # join + filter + projection
+        "q04_join_filter": od.join(
+            li, on=od["o_orderkey"] == li["l_orderkey"]
+        )
+        .filter(od["o_custkey"] == 7)
+        .select("o_orderkey", "l_extendedprice"),
+        # aggregate over an index-served filter
+        "q05_filter_agg": li.filter(li["l_orderkey"] == 42)
+        .group_by("l_orderkey")
+        .agg(F.sum("l_quantity").alias("qty")),
+        # customer dimension join
+        "q06_dim_join": cu.join(od, on=cu["c_custkey"] == od["o_custkey"]).select(
+            "c_custkey", "c_mktsegment", "o_totalprice"
+        ),
+        # top-n
+        "q07_topn": li.select("l_orderkey", "l_extendedprice")
+        .sort(("l_extendedprice", False))
+        .limit(5),
+        # no index applies (predicate not on a first indexed column)
+        "q08_no_index": li.filter(li["l_quantity"] == 1).select(
+            "l_quantity", "l_extendedprice"
+        ),
+    }
+
+
+def simplify(plan_str: str, root: str) -> str:
+    """Path- and version-independent plan text (the reference's
+    'simplified plan': stable across machines and reruns)."""
+    s = plan_str.replace(root, "<tpch>")
+    s = re.sub(r"LogVersion: \d+", "LogVersion: N", s)
+    s = re.sub(r"/[^ \[\]]*/indexes", "<system>", s)
+    return s + "\n"
+
+
+QUERY_NAMES = [
+    "q01_point_filter",
+    "q02_range_skip",
+    "q03_join",
+    "q04_join_filter",
+    "q05_filter_agg",
+    "q06_dim_join",
+    "q07_topn",
+    "q08_no_index",
+]
+
+
+@pytest.mark.parametrize("qname", QUERY_NAMES)
+def test_plan_stability(qname, session, tpch):
+    queries = _queries(tpch)
+    df = queries[qname]
+    got = simplify(session.optimize(df.logical_plan).pretty(), tpch["root"])
+    golden_path = os.path.join(GOLDEN_DIR, f"{qname}.txt")
+    if GENERATE:
+        os.makedirs(GOLDEN_DIR, exist_ok=True)
+        with open(golden_path, "w") as f:
+            f.write(got)
+        pytest.skip("golden file regenerated")
+    assert os.path.exists(golden_path), (
+        f"Missing golden file {golden_path}; run with HS_GENERATE_GOLDEN_FILES=1"
+    )
+    with open(golden_path) as f:
+        want = f.read()
+    assert got == want, (
+        f"Plan changed for {qname}.\n--- approved ---\n{want}\n--- got ---\n{got}\n"
+        "If intentional, regenerate with HS_GENERATE_GOLDEN_FILES=1 and review."
+    )
+    # the plan must also EXECUTE and match the unindexed answer
+    with_idx = df.collect()
+    session.disable_hyperspace()
+    base = df.collect()
+    session.enable_hyperspace()
+    key = lambda t: t.sort_by([(c, "ascending") for c in t.column_names])
+    if qname == "q07_topn":
+        # top-n with ties can pick different rows; compare the sort column
+        assert with_idx.column("l_extendedprice").to_pylist() == (
+            base.column("l_extendedprice").to_pylist()
+        )
+    else:
+        assert key(with_idx).equals(key(base))

@@ -159,6 +159,74 @@ class TestNaNMinMaxSketch:
         assert sorted(out.column("x").to_pylist()) == [1.0, 2.0]
 
 
+class TestTemporalLiterals:
+    """Date/timestamp literals in predicates lower to the column's int64
+    storage units — identically on the host and device filter paths."""
+
+    @pytest.fixture
+    def dated(self, session, tmp_path):
+        d = tmp_path / "dated"
+        d.mkdir()
+        base = np.datetime64("1994-01-01")
+        dates = (base + np.arange(1000).astype("timedelta64[D]")).astype(
+            "datetime64[D]"
+        )
+        ts = dates.astype("datetime64[us]")
+        pq.write_table(
+            pa.table(
+                {
+                    "d": pa.array(dates),
+                    "ts": pa.array(ts),
+                    "v": pa.array(np.arange(1000), type=pa.int64()),
+                }
+            ),
+            d / "a.parquet",
+        )
+        return session.read.parquet(str(d))
+
+    def test_date_range_filter(self, dated):
+        import datetime
+
+        out = dated.filter(
+            dated["d"] >= np.datetime64("1996-01-01")
+        ).select("d", "v")
+        got = out.collect()
+        assert got.num_rows == 270
+        assert min(got.column("d").to_pylist()) == datetime.date(1996, 1, 1)
+
+    def test_date_literal_spellings_agree(self, dated):
+        import datetime
+
+        for lit in (
+            np.datetime64("1995-03-01"),
+            datetime.date(1995, 3, 1),
+            "1995-03-01",
+        ):
+            got = dated.filter(dated["d"] == lit).collect()
+            assert got.num_rows == 1, lit
+
+    def test_date_literal_on_timestamp_column(self, dated):
+        import datetime
+
+        got = dated.filter(
+            dated["ts"] == datetime.date(1995, 3, 1)
+        ).collect()
+        assert got.num_rows == 1
+
+    def test_date_in_list(self, dated):
+        got = dated.filter(
+            dated["d"].isin(
+                np.datetime64("1994-02-01"), np.datetime64("1994-03-01")
+            )
+        ).collect()
+        assert got.num_rows == 2
+
+    def test_unrepresentable_literal(self, dated):
+        assert dated.filter(dated["d"] == "not-a-date").collect().num_rows == 0
+        out = dated.filter(dated["d"] != "not-a-date").collect()
+        assert out.num_rows == 1000
+
+
 class TestLimitPushdown:
     def test_limit_reads_only_needed_files(self, session, tmp_path, monkeypatch):
         t = pa.table({"x": pa.array(np.arange(1000), type=pa.int64())})
