@@ -83,7 +83,8 @@ INDEX_SOURCES_PROVIDERS_DEFAULT = (
 )
 
 DEFAULT_SUPPORTED_FORMATS = "hyperspace.index.sources.defaultSupportedFormats"
-DEFAULT_SUPPORTED_FORMATS_DEFAULT = "csv,json,parquet"
+# reference default: DefaultFileBasedSource.scala:76-85
+DEFAULT_SUPPORTED_FORMATS_DEFAULT = "avro,csv,json,orc,parquet,text"
 
 # Streaming build: cap the bytes materialized per wave of the covering
 # index build (0 = unbounded, one in-memory pass). The reference gets

@@ -76,6 +76,27 @@ def read_table(
         elif fmt == "json":
             t = pajson.read_json(p)
             tables.append(t.select(list(columns)) if columns else t)
+        elif fmt == "orc":
+            from pyarrow import orc as paorc
+
+            t = paorc.read_table(p, columns=list(columns) if columns else None)
+            tables.append(t)
+        elif fmt == "text":
+            # Spark's text source shape: one string column named `value`
+            with open(p, "r", encoding="utf-8") as fh:
+                lines = fh.read().splitlines()
+            t = pa.table({"value": pa.array(lines, type=pa.string())})
+            tables.append(t.select(list(columns)) if columns else t)
+        elif fmt == "avro":
+            from hyperspace_tpu.utils.avro import read_avro_with_schema
+
+            avro_schema, records = read_avro_with_schema(p)
+            arrow_schema = _avro_to_arrow_schema(avro_schema)
+            if arrow_schema is not None:
+                t = pa.Table.from_pylist(list(records), schema=arrow_schema)
+            else:  # non-record / exotic top-level schema: infer from values
+                t = pa.Table.from_pylist(list(records))
+            tables.append(t.select(list(columns)) if columns else t)
         else:
             raise HyperspaceException(f"Unsupported format: {fmt}")
     if not tables:
@@ -94,8 +115,79 @@ def list_format_files(root: str, fmt: str = "parquet") -> List[str]:
     hidden-path filtering Spark's ``DataPathFilter`` applies)."""
     from hyperspace_tpu.utils.files import list_leaf_files
 
-    ext = {"parquet": ".parquet", "csv": ".csv", "json": ".json"}[fmt]
+    ext = {
+        "parquet": ".parquet",
+        "csv": ".csv",
+        "json": ".json",
+        "orc": ".orc",
+        "avro": ".avro",
+        "text": ".txt",
+    }[fmt]
     return sorted(p for p, _s, _m in list_leaf_files(root, suffix=ext, data_only=True))
+
+
+def _avro_to_arrow_schema(avro_schema) -> Optional[pa.Schema]:
+    """Arrow schema from an Avro record schema (embedded-schema-driven
+    typing, so empty/all-null files concat cleanly with siblings). Returns
+    None when the top level is not a record or a field type is beyond the
+    primitive/union-with-null set (caller falls back to value inference)."""
+    prim = {
+        "boolean": pa.bool_(),
+        "int": pa.int32(),
+        "long": pa.int64(),
+        "float": pa.float32(),
+        "double": pa.float64(),
+        "bytes": pa.binary(),
+        "string": pa.string(),
+    }
+
+    def field_type(t):
+        if isinstance(t, list):  # union: only [null, prim] shapes
+            non_null = [x for x in t if x != "null"]
+            if len(non_null) != 1:
+                return None
+            return field_type(non_null[0])
+        if isinstance(t, str):
+            return prim.get(t)
+        return None
+
+    if not isinstance(avro_schema, dict) or avro_schema.get("type") != "record":
+        return None
+    fields = []
+    for f in avro_schema.get("fields", []):
+        at = field_type(f["type"])
+        if at is None:
+            return None
+        fields.append(pa.field(f["name"], at))
+    return pa.schema(fields)
+
+
+def has_glob_magic(path: str) -> bool:
+    """True when the path is a glob pattern (single home of the
+    magic-character rule — session reader and expansion must agree)."""
+    return any(ch in path for ch in "*?[")
+
+
+def expand_path(path: str, fmt: str) -> List[str]:
+    """Data files for one reader path: a file, a directory, or a glob
+    pattern (the reference validates globbed roots against their current
+    expansion, DefaultFileBasedRelation.scala:159-187 — keeping the
+    PATTERN as the root path and re-expanding on every listing gives the
+    same always-current semantics)."""
+    import glob as _glob
+    import os
+
+    if has_glob_magic(path):
+        out: List[str] = []
+        for m in sorted(_glob.glob(path)):
+            if os.path.isfile(m):
+                out.append(m)
+            else:
+                out.extend(list_format_files(m, fmt))
+        return out
+    if os.path.isfile(path):
+        return [path]
+    return list_format_files(path, fmt)
 
 
 def bucket_file_name(file_idx: int, bucket: int) -> str:

@@ -102,11 +102,11 @@ class _Prep:
                     right.value, self.batch.column(left.name).arrow_type
                 )
                 if lit is None:
-                    # unrepresentable: = / orderings never true; != true
-                    # for every non-null row (NOT IS NULL)
-                    if op == "!=":
-                        return ("not", ("isnull", cspec))
-                    return ("const", False)
+                    # unrepresentable literal: constant truth value but
+                    # UNKNOWN on null rows — mirrors the host path's
+                    # (vals, column-validity) exactly so NOT composes the
+                    # same on both paths
+                    return ("unrep", op == "!=", cspec)
                 return ("cmp_lit", op, cspec, self._arg(np.asarray(lit)))
             if isinstance(left, E.Col) and isinstance(right, E.Col):
                 lspec, lref = self._col(left.name)
@@ -213,6 +213,12 @@ def _eval_spec(spec, args, n):
         _c, vslot, valslot, _k, _name = spec[1]
         valid = t() if valslot == -1 else args[valslot]
         return ~valid, t()
+    if kind == "unrep":
+        # constant truth value, unknown on null rows (host-path twin of
+        # the unrepresentable-literal comparison)
+        _c, vslot, valslot, _k, _name = spec[2]
+        valid = t() if valslot == -1 else args[valslot]
+        return jnp.full(n, spec[1]), valid
     if kind == "in":
         _c, vslot, valslot, _k, _name = spec[1]
         v = args[vslot]

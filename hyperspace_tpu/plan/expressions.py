@@ -236,10 +236,34 @@ def lower_literal(value, arrow_type):
     dt64 = _as_datetime64(value)
     if dt64 is None:
         return None
-    conv = dt64.astype(f"datetime64[{unit}]")
-    if conv.astype(dt64.dtype) != dt64:
-        return None  # lossy (e.g. ns-precision literal vs µs column)
-    return np.int64(conv.view("int64"))
+    # exact python-int arithmetic: NEVER let numpy overflow silently.
+    # A literal beyond the column unit's representable range still has a
+    # definite ordering answer, so it clamps to ±inf (int64-vs-float
+    # comparisons give the right result; equality against ±inf is False).
+    src_unit = np.datetime_data(dt64.dtype)[0]
+    if src_unit in ("Y", "M", "W"):
+        dt64 = dt64.astype("datetime64[D]")  # exact calendar conversion
+        src_unit = "D"
+    ns_per = {
+        "D": 86_400_000_000_000,
+        "h": 3_600_000_000_000,
+        "m": 60_000_000_000,
+        "s": 1_000_000_000,
+        "ms": 1_000_000,
+        "us": 1_000,
+        "ns": 1,
+    }
+    if src_unit not in ns_per:
+        return None  # sub-ns units (ps/fs/as): beyond engine precision
+    v_ns = int(dt64.view("int64")) * ns_per[src_unit]
+    q, r = divmod(v_ns, ns_per[unit])
+    if r != 0:
+        return None  # sub-unit precision: unrepresentable in the column
+    if q > np.iinfo(np.int64).max:
+        return np.float64("inf")
+    if q < np.iinfo(np.int64).min:
+        return np.float64("-inf")
+    return np.int64(q)
 
 
 def _temporal_storage_unit(arrow_type):

@@ -87,16 +87,20 @@ def _stat_to_float(v) -> float:
     return _norm(v)
 
 
-def _footer_ranges(files, column: str):
+def _footer_ranges(files, column: str, metadata_cache: Dict[str, object]):
     """Per-file (lo, hi) from parquet row-group statistics, or None when
     any file lacks min/max stats for the column (caller falls back to a
     data read for the whole column — scales must not mix). Entries are
-    None for all-null files."""
+    None for all-null files. ``metadata_cache`` holds each file's parsed
+    footer so N analyzed columns cost one footer parse per file, not N."""
     import pyarrow.parquet as pq
 
     out = []
     for f in files:
-        md = pq.ParquetFile(f).metadata
+        md = metadata_cache.get(f)
+        if md is None:
+            md = pq.ParquetFile(f).metadata
+            metadata_cache[f] = md
         lo = hi = None
         for rg in range(md.num_row_groups):
             row_group = md.row_group(rg)
@@ -223,12 +227,13 @@ def analyze_min_max(
     # parquet-like sources; floats need the NaN-aware data read (parquet
     # float stats are writer-dependent around NaN)
     data_cols = []
+    footer_md_cache: Dict[str, object] = {}
     for c in numeric_cols:
         footer = None
         if rel.fmt in ("parquet", "delta", "iceberg") and not (
             pa.types.is_floating(schema[c])
         ):
-            footer = _footer_ranges(rel.files, c)
+            footer = _footer_ranges(rel.files, c, footer_md_cache)
         if footer is None:
             data_cols.append(c)
             continue

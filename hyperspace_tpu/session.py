@@ -34,14 +34,11 @@ class DataFrameReader:
         self._session = session
 
     def _scan(self, fmt: str, paths: Sequence[str]) -> DataFrame:
-        from hyperspace_tpu.io.parquet import list_format_files, read_table
+        from hyperspace_tpu.io.parquet import expand_path, read_table
 
         files: List[str] = []
         for p in paths:
-            if os.path.isfile(p):
-                files.append(p)
-            else:
-                files.extend(list_format_files(p, fmt))
+            files.extend(expand_path(p, fmt))
         if not files:
             raise HyperspaceException(f"No {fmt} files under {list(paths)}")
         if fmt == "parquet":
@@ -50,6 +47,9 @@ class DataFrameReader:
         else:
             head = read_table(files[:1], None, fmt)
             fields = tuple((n, head.schema.field(n).type) for n in head.column_names)
+        # glob patterns stay patterns in root_paths (re-expanded on every
+        # refresh/signature listing) — but absolutized like plain paths,
+        # or re-expansion would depend on the process cwd
         rel = Relation(
             root_paths=tuple(os.path.abspath(p) for p in paths),
             files=tuple(os.path.abspath(f) for f in files),
@@ -66,6 +66,15 @@ class DataFrameReader:
 
     def json(self, *paths: str) -> DataFrame:
         return self._scan("json", paths)
+
+    def orc(self, *paths: str) -> DataFrame:
+        return self._scan("orc", paths)
+
+    def avro(self, *paths: str) -> DataFrame:
+        return self._scan("avro", paths)
+
+    def text(self, *paths: str) -> DataFrame:
+        return self._scan("text", paths)
 
     def delta(self, path: str, version_as_of: Optional[int] = None) -> DataFrame:
         """Read a Delta Lake table (optionally pinned to a version — the

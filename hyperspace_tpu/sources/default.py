@@ -1,9 +1,10 @@
-"""Default file-based source provider: plain parquet/csv/json directories.
+"""Default file-based source provider: plain file-format directories.
 
 Reference: ``sources/default/DefaultFileBasedSource.scala:37-124`` (formats
-from conf, default avro,csv,json,orc,parquet,text — ours: csv,json,parquet),
+from conf, default avro,csv,json,orc,parquet,text — same set here),
 ``DefaultFileBasedRelation.scala:38-242`` (signature = md5 fold over
-(len, mtime, path) of all files), ``DefaultFileBasedRelationMetadata.scala``.
+(len, mtime, path) of all files; globbed roots re-expanded on every
+listing), ``DefaultFileBasedRelationMetadata.scala``.
 """
 
 from __future__ import annotations
@@ -58,14 +59,11 @@ class DefaultFileBasedRelation(FileBasedRelation):
         )
 
     def refresh(self) -> "DefaultFileBasedRelation":
-        from hyperspace_tpu.io.parquet import list_format_files
+        from hyperspace_tpu.io.parquet import expand_path
 
         files: List[str] = []
         for p in self.plan_relation.root_paths:
-            if os.path.isfile(p):
-                files.append(p)
-            else:
-                files.extend(list_format_files(p, self.plan_relation.fmt))
+            files.extend(expand_path(p, self.plan_relation.fmt))
         import dataclasses
 
         rel = dataclasses.replace(self.plan_relation, files=tuple(files))

@@ -201,6 +201,13 @@ def _encode(schema, value, out: io.BytesIO) -> None:
 
 def read_avro(path: str) -> List[Any]:
     """All records of an Avro object-container file."""
+    return read_avro_with_schema(path)[1]
+
+
+def read_avro_with_schema(path: str):
+    """(avro_schema_dict, records) of an Avro object-container file —
+    the embedded schema drives Arrow typing for empty/all-null files
+    where value inference has nothing to go on."""
     with open(path, "rb") as f:
         data = f.read()
     buf = io.BytesIO(data)
@@ -225,7 +232,7 @@ def read_avro(path: str) -> List[Any]:
             records.append(_decode(schema, bbuf))
         if buf.read(16) != sync:
             raise HyperspaceException(f"Avro sync marker mismatch in {path}")
-    return records
+    return schema, records
 
 
 def write_avro(path: str, schema: dict, records: Iterable[Any]) -> None:

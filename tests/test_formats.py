@@ -1,0 +1,169 @@
+"""Default-source formats (avro/csv/json/orc/parquet/text) + glob roots.
+
+Reference: ``DefaultFileBasedSource.scala:76-85`` (the six formats from
+conf) and ``DefaultFileBasedRelation.scala:159-187`` (globbed root
+handling). Text follows Spark's shape: one string column named ``value``.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+
+
+def sorted_table(t):
+    return t.sort_by([(c, "ascending") for c in t.column_names])
+
+
+class TestOrc:
+    def test_read_index_serve(self, session, tmp_path):
+        from pyarrow import orc as paorc
+
+        rng = np.random.default_rng(3)
+        d = tmp_path / "orcsrc"
+        d.mkdir()
+        for i in range(2):
+            t = pa.table(
+                {
+                    "k": pa.array(rng.integers(0, 50, 300), type=pa.int64()),
+                    "v": pa.array(rng.normal(size=300)),
+                }
+            )
+            paorc.write_table(t, str(d / f"f{i}.orc"))
+        df = session.read.orc(str(d))
+        assert df.count() == 600
+        hs = Hyperspace(session)
+        hs.create_index(df, CoveringIndexConfig("oidx", ["k"], ["v"]))
+        session.enable_hyperspace()
+        q = lambda dd: dd.filter(dd["k"] == 7).select("k", "v")
+        plan = q(df).explain()
+        assert "Hyperspace(Type: CI, Name: oidx" in plan
+        session.disable_hyperspace()
+        base = q(df).collect()
+        session.enable_hyperspace()
+        assert sorted_table(q(df).collect()).equals(sorted_table(base))
+
+
+class TestText:
+    def test_read_filter(self, session, tmp_path):
+        d = tmp_path / "txt"
+        d.mkdir()
+        (d / "a.txt").write_text("alpha\nbeta\ngamma\n")
+        (d / "b.txt").write_text("delta\nbeta\n")
+        df = session.read.text(str(d))
+        assert df.columns == ["value"]
+        assert df.count() == 5
+        out = df.filter(df["value"] == "beta").collect()
+        assert out.num_rows == 2
+
+
+class TestAvro:
+    def test_read_filter(self, session, tmp_path):
+        from hyperspace_tpu.utils.avro import write_avro
+
+        d = tmp_path / "av"
+        d.mkdir()
+        schema = {
+            "type": "record",
+            "name": "row",
+            "fields": [
+                {"name": "k", "type": "long"},
+                {"name": "s", "type": "string"},
+            ],
+        }
+        write_avro(
+            str(d / "a.avro"),
+            schema,
+            [{"k": i, "s": f"v{i % 3}"} for i in range(30)],
+        )
+        df = session.read.avro(str(d))
+        assert df.count() == 30
+        out = df.filter(df["s"] == "v1").collect()
+        assert out.num_rows == 10
+
+    def test_empty_avro_file_concats(self, session, tmp_path):
+        """An empty container file has no values to infer types from; the
+        embedded schema must drive the Arrow types so the multi-file
+        concat still works."""
+        from hyperspace_tpu.utils.avro import write_avro
+
+        d = tmp_path / "av2"
+        d.mkdir()
+        schema = {
+            "type": "record",
+            "name": "row",
+            "fields": [
+                {"name": "k", "type": "long"},
+                {"name": "s", "type": ["null", "string"]},
+            ],
+        }
+        write_avro(str(d / "a.avro"), schema, [{"k": 1, "s": "x"}])
+        write_avro(str(d / "b.avro"), schema, [])
+        write_avro(str(d / "c.avro"), schema, [{"k": 2, "s": None}])
+        df = session.read.avro(str(d))
+        out = df.collect()
+        assert out.num_rows == 2
+        assert str(out.schema.field("k").type) == "int64"
+
+
+class TestGlobRoots:
+    def test_glob_read_and_refresh(self, session, tmp_path):
+        d = tmp_path / "g"
+        d.mkdir()
+        rng = np.random.default_rng(1)
+        for i in range(2):
+            pq.write_table(
+                pa.table(
+                    {
+                        "k": pa.array(rng.integers(0, 20, 100), pa.int64()),
+                        "v": pa.array(rng.normal(size=100)),
+                    }
+                ),
+                d / f"part-{i}.parquet",
+            )
+        # decoy NOT matching the pattern
+        pq.write_table(
+            pa.table(
+                {
+                    "k": pa.array([999] * 5, pa.int64()),
+                    "v": pa.array([0.0] * 5),
+                }
+            ),
+            d / "other.parquet",
+        )
+        pattern = str(d / "part-*.parquet")
+        df = session.read.parquet(pattern)
+        assert df.count() == 200  # decoy excluded
+        hs = Hyperspace(session)
+        session.conf.set(C.INDEX_LINEAGE_ENABLED, True)
+        hs.create_index(df, CoveringIndexConfig("gidx", ["k"], ["v"]))
+        entry = session.index_manager.get_index_log_entry("gidx")
+        assert entry.relation.root_paths == [pattern]
+        # append a file MATCHING the pattern; refresh must pick it up
+        pq.write_table(
+            pa.table(
+                {
+                    "k": pa.array([5] * 7, pa.int64()),
+                    "v": pa.array([1.0] * 7),
+                }
+            ),
+            d / "part-9.parquet",
+        )
+        hs.refresh_index("gidx", C.REFRESH_MODE_INCREMENTAL)
+        session.index_manager.clear_cache()
+        df2 = session.read.parquet(pattern)
+        session.enable_hyperspace()
+        q = df2.filter(df2["k"] == 5).select("k", "v")
+        assert "Hyperspace(Type: CI, Name: gidx" in q.explain()
+        session.disable_hyperspace()
+        base = q.collect()
+        session.enable_hyperspace()
+        got = q.collect()
+        assert sorted_table(got).equals(sorted_table(base))
+        assert got.num_rows >= 7

@@ -226,6 +226,50 @@ class TestTemporalLiterals:
         out = dated.filter(dated["d"] != "not-a-date").collect()
         assert out.num_rows == 1000
 
+    def test_out_of_range_literal_orders_correctly(self, session, tmp_path):
+        """A literal beyond the column unit's int64 range clamps to ±inf:
+        orderings keep their definite answer instead of silently matching
+        nothing (numpy overflow used to wrap)."""
+        d = tmp_path / "ns"
+        d.mkdir()
+        ts = np.array(
+            ["2020-01-01T00:00:00", "2021-01-01T00:00:00"],
+            dtype="datetime64[ns]",
+        )
+        pq.write_table(pa.table({"ts": pa.array(ts)}), d / "a.parquet")
+        df = session.read.parquet(str(d))
+        # 2300 overflows int64 nanoseconds (max ~2262): all rows are below
+        assert df.filter(df["ts"] < np.datetime64("2300-01-01")).collect().num_rows == 2
+        assert df.filter(df["ts"] > np.datetime64("2300-01-01")).collect().num_rows == 0
+        assert df.filter(df["ts"] == np.datetime64("2300-01-01")).collect().num_rows == 0
+
+    def test_not_unrepresentable_excludes_nulls_both_paths(
+        self, session, tmp_path
+    ):
+        """~(col == <garbage>) must exclude null rows identically on the
+        host evaluator and the device filter."""
+        from hyperspace_tpu.io.columnar import ColumnarBatch
+        from hyperspace_tpu.ops.filter import device_filter_mask
+        from hyperspace_tpu.plan import expressions as E
+
+        import datetime
+
+        d = tmp_path / "nn"
+        d.mkdir()
+        pq.write_table(
+            pa.table(
+                {"d": pa.array([datetime.date(2020, 1, 1), None], type=pa.date32())}
+            ),
+            d / "a.parquet",
+        )
+        df = session.read.parquet(str(d))
+        cond = ~(E.Col("d") == "not-a-date")
+        batch = ColumnarBatch.from_arrow(df.collect())
+        host = E.filter_mask(cond, batch)
+        dev = device_filter_mask(cond, batch)
+        assert host.tolist() == [True, False]
+        assert dev.tolist() == host.tolist()
+
 
 class TestLimitPushdown:
     def test_limit_reads_only_needed_files(self, session, tmp_path, monkeypatch):
