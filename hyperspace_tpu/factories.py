@@ -1,0 +1,30 @@
+"""Dependency-injection seams for the metadata plane.
+
+Reference: ``index/factories.scala:26-50`` — the reference routes every
+log/data/FS manager construction through factory objects so tests can
+swap in mocks and exercise failure paths (mid-action crashes, flaky
+storage) without real faults. Same seam here: the collection manager
+builds all per-index managers through these module-level factories;
+tests reassign them (and restore afterwards, e.g. via pytest
+monkeypatch.setattr).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from hyperspace_tpu.metadata.data_manager import IndexDataManager
+from hyperspace_tpu.metadata.log_manager import IndexLogManager
+
+# callable(index_path) -> log manager
+log_manager_factory: Callable[[str], IndexLogManager] = IndexLogManager
+# callable(index_path) -> data manager
+data_manager_factory: Callable[[str], IndexDataManager] = IndexDataManager
+
+
+def create_log_manager(index_path: str) -> IndexLogManager:
+    return log_manager_factory(index_path)
+
+
+def create_data_manager(index_path: str) -> IndexDataManager:
+    return data_manager_factory(index_path)

@@ -28,8 +28,13 @@ class IndexCollectionManager:
 
     # -- wiring -------------------------------------------------------------
     def _managers(self, index_name: str):
+        from hyperspace_tpu import factories
+
         path = self.path_resolver.get_index_path(index_name)
-        return IndexLogManager(path), IndexDataManager(path)
+        return (
+            factories.create_log_manager(path),
+            factories.create_data_manager(path),
+        )
 
     # -- operations (IndexManager trait, index/IndexManager.scala:24-127) ---
     def create(self, df, index_config) -> None:
@@ -107,9 +112,11 @@ class IndexCollectionManager:
         return log_mgr.get_latest_stable_log()
 
     def get_indexes(self, states: Optional[List[str]] = None) -> List[IndexLogEntry]:
+        from hyperspace_tpu import factories
+
         out = []
         for path in self.path_resolver.all_index_paths():
-            entry = IndexLogManager(path).get_latest_stable_log()
+            entry = factories.create_log_manager(path).get_latest_stable_log()
             if entry is None:
                 continue
             if states is None or entry.state in states:

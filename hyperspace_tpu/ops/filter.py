@@ -134,8 +134,14 @@ class _Prep:
             if not isinstance(e.child, E.Col):
                 raise Unsupported(f"IN on non-column: {e!r}")
             cspec, ref = self._col(e.child.name)
+            # a NULL in the list makes non-matching rows UNKNOWN (host twin)
+            has_null = any(v is None for v in e.values)
             vals = [v for v in e.values if v is not None]
             if not vals:
+                if has_null:
+                    # x IN (NULL): unknown on every row (host: vals=0,
+                    # known=0) — exactly the null-literal spec
+                    return ("null",)
                 # x IN () is never true (matches the host path's all-False)
                 return ("const", False)
             if ref is not None:
@@ -158,7 +164,7 @@ class _Prep:
                 arr = np.sort(np.array(lits))
                 if arr.dtype.kind not in "iuf":
                     raise Unsupported(f"IN literal set: {e!r}")
-            return ("in", cspec, self._arg(arr))
+            return ("in", cspec, self._arg(arr), has_null)
         raise Unsupported(f"Expression not device-compilable: {e!r}")
 
 
@@ -229,6 +235,8 @@ def _eval_spec(spec, args, n):
         pos = jnp.searchsorted(lits, v)
         pos = jnp.clip(pos, 0, lits.shape[0] - 1)
         vals = lits[pos] == v
+        if len(spec) > 3 and spec[3]:  # NULL in the list: non-matches unknown
+            valid = valid & vals
         return vals, valid
     raise HyperspaceException(f"Bad spec node: {spec!r}")
 

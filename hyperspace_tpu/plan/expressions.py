@@ -257,12 +257,20 @@ def lower_literal(value, arrow_type):
         return None  # sub-ns units (ps/fs/as): beyond engine precision
     v_ns = int(dt64.view("int64")) * ns_per[src_unit]
     q, r = divmod(v_ns, ns_per[unit])
-    if r != 0:
-        return None  # sub-unit precision: unrepresentable in the column
     if q > np.iinfo(np.int64).max:
         return np.float64("inf")
     if q < np.iinfo(np.int64).min:
         return np.float64("-inf")
+    if r != 0:
+        # literal falls BETWEEN two column ticks: q + 0.5 gives every
+        # comparison its exact answer (col <= q ⟺ col < lit; equality is
+        # False since no int equals x.5). Exact while q < 2^53 — true for
+        # every unit coarser than ns, which is the only way r != 0 arises
+        # (ns literals against a coarser column); beyond float precision
+        # fall back to unrepresentable.
+        if abs(q) >= (1 << 53):
+            return None
+        return np.float64(q) + 0.5
     return np.int64(q)
 
 
@@ -338,7 +346,11 @@ def lower_in_literals(values, arrow_type) -> List[Any]:
             if v is None:
                 continue
             lv = lower_literal(v, arrow_type)
-            if lv is not None:
+            # only exact column ticks can match equality: drop ±inf
+            # (out-of-range) and x.5 (between ticks) — a float in the
+            # list would also upcast the whole array and break int64
+            # equality beyond 2^53
+            if lv is not None and isinstance(lv, np.int64):
                 out.append(lv)
         return out
     return [v for v in values if isinstance(v, (int, float, bool))]
@@ -565,13 +577,24 @@ def evaluate(expr: Expr, batch) -> Tuple[np.ndarray, Optional[np.ndarray]]:
         if not isinstance(expr.child, Col):
             raise HyperspaceException("IN requires a column operand")
         vref, valid = _column_ref(batch, expr.child.name)
+        # SQL: a NULL in the list makes non-matching rows UNKNOWN (x IN
+        # (1, NULL) is TRUE iff x=1, else NULL) — so NOT IN with a NULL
+        # returns no rows
+        has_null = any(v is None for v in expr.values)
+
+        def with_null(vals, valid):
+            if not has_null:
+                return vals, valid
+            valid = np.ones(n, bool) if valid is None else valid
+            return vals, valid & vals
+
         if isinstance(vref, _StringRef):
             codes = {
                 vref.code_of(v) for v in expr.values if isinstance(v, str)
             }
             codes.discard(-2)
             vals = np.isin(vref.codes, np.array(sorted(codes), dtype=np.int64))
-            return vals, vref.valid
+            return with_null(vals, vref.valid)
         # type-compatible literals only: 5 matches isin(5, "a") on an int
         # column, the string can never match and must not poison the
         # comparison dtype; temporal literals lower to int64 units
@@ -579,9 +602,9 @@ def evaluate(expr: Expr, batch) -> Tuple[np.ndarray, Optional[np.ndarray]]:
             expr.values, batch.column(expr.child.name).arrow_type
         )
         if not lits:
-            return np.zeros(n, bool), valid
+            return with_null(np.zeros(n, bool), valid)
         vals = np.isin(vref, np.array(lits))
-        return vals, valid
+        return with_null(vals, valid)
     raise HyperspaceException(f"Cannot evaluate expression: {expr!r}")
 
 

@@ -122,6 +122,7 @@ class HyperspaceSession:
         self._hyperspace_enabled = False
         self._source_manager = None
         self._index_manager = None
+        self._catalog: dict = {}
 
     # -- context (HyperspaceContext, Hyperspace.scala:195-223) --------------
     @property
@@ -144,6 +145,16 @@ class HyperspaceSession:
     @property
     def read(self) -> DataFrameReader:
         return DataFrameReader(self)
+
+    # -- SQL surface (HyperspaceSparkSessionExtension.scala:44-69 analogue:
+    # SQL flows through the same optimizer, so index rewrites apply) ------
+    def register_view(self, name: str, df: DataFrame) -> None:
+        self._catalog[name.lower()] = df
+
+    def sql(self, query: str) -> DataFrame:
+        from hyperspace_tpu.sql import parse_sql
+
+        return parse_sql(self, query, self._catalog)
 
     # -- hyperspace enable/disable (package.scala:40-80) --------------------
     def enable_hyperspace(self) -> "HyperspaceSession":

@@ -133,9 +133,9 @@ class DeltaLakeRelation(FileBasedRelation):
         )
         if best_log == entry.id:
             return entry
-        from hyperspace_tpu.metadata.log_manager import IndexLogManager
+        from hyperspace_tpu import factories
         from hyperspace_tpu.metadata.path_resolver import PathResolver
 
         path = PathResolver(self.session.conf).get_index_path(entry.name)
-        hist = IndexLogManager(path).get_log(best_log)
+        hist = factories.create_log_manager(path).get_log(best_log)
         return hist if hist is not None else entry

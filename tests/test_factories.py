@@ -1,0 +1,105 @@
+"""Fault injection through the DI factory seams.
+
+Reference pattern: ``IndexCollectionManagerTest`` swaps mock
+FileSystem/log-manager factories (``index/factories.scala:26-50``) to
+exercise failure paths. Here a failing log/data manager is injected via
+``hyperspace_tpu.factories`` and the action protocol's recovery contract
+is asserted: a mid-action crash leaves a transient state that blocks
+further operations until ``cancel()`` rolls back to the last stable state.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu import factories
+from hyperspace_tpu.constants import States
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+from hyperspace_tpu.metadata.data_manager import IndexDataManager
+from hyperspace_tpu.metadata.log_manager import IndexLogManager
+
+
+@pytest.fixture
+def src(tmp_path):
+    d = tmp_path / "src"
+    d.mkdir()
+    rng = np.random.default_rng(0)
+    pq.write_table(
+        pa.table(
+            {
+                "k": pa.array(rng.integers(0, 20, 100), type=pa.int64()),
+                "v": pa.array(rng.normal(size=100)),
+            }
+        ),
+        d / "a.parquet",
+    )
+    return str(d)
+
+
+class FailingEndLogManager(IndexLogManager):
+    """Crashes on the action's end-phase write (the second write_log)."""
+
+    def __init__(self, path):
+        super().__init__(path)
+        self._writes = 0
+
+    def write_log(self, log_id, entry):
+        self._writes += 1
+        if self._writes >= 2:
+            raise OSError("injected: storage failed at end()")
+        return super().write_log(log_id, entry)
+
+
+def test_crash_at_end_leaves_transient_state_cancel_recovers(
+    session, src, monkeypatch
+):
+    hs = Hyperspace(session)
+    df = session.read.parquet(src)
+    monkeypatch.setattr(factories, "log_manager_factory", FailingEndLogManager)
+    with pytest.raises(OSError, match="injected"):
+        hs.create_index(df, CoveringIndexConfig("fidx", ["k"], ["v"]))
+    # back to real managers: index is stuck in transient CREATING
+    monkeypatch.setattr(factories, "log_manager_factory", IndexLogManager)
+    session.index_manager.clear_cache()
+    entry = session.index_manager._managers("fidx")[0].get_latest_log()
+    assert entry.state == States.CREATING
+    # further operations are blocked until cancel
+    with pytest.raises(HyperspaceException):
+        hs.refresh_index("fidx")
+    hs.cancel("fidx")
+    entry = session.index_manager._managers("fidx")[0].get_latest_log()
+    assert entry.state in States.STABLE_STATES
+    # and a clean re-create now succeeds
+    session.index_manager.clear_cache()
+    hs.create_index(df, CoveringIndexConfig("fidx2", ["k"], ["v"]))
+    assert (
+        session.index_manager.get_index_log_entry("fidx2").state
+        == States.ACTIVE
+    )
+
+
+class FailingDataManager:
+    """Data manager whose version allocation always fails (op() crash)."""
+
+    def __init__(self, path):
+        raise OSError("injected: data manager unavailable")
+
+
+def test_data_manager_failure_does_not_corrupt_log(session, src, monkeypatch):
+    hs = Hyperspace(session)
+    df = session.read.parquet(src)
+    monkeypatch.setattr(factories, "data_manager_factory", FailingDataManager)
+    with pytest.raises(OSError, match="injected"):
+        hs.create_index(df, CoveringIndexConfig("didx", ["k"], ["v"]))
+    monkeypatch.setattr(factories, "data_manager_factory", IndexDataManager)
+    session.index_manager.clear_cache()
+    # nothing was written: index does not exist, create works afterwards
+    assert session.index_manager.get_index_log_entry("didx") is None
+    hs.create_index(df, CoveringIndexConfig("didx", ["k"], ["v"]))
+    assert (
+        session.index_manager.get_index_log_entry("didx").state == States.ACTIVE
+    )

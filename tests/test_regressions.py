@@ -243,6 +243,25 @@ class TestTemporalLiterals:
         assert df.filter(df["ts"] > np.datetime64("2300-01-01")).collect().num_rows == 0
         assert df.filter(df["ts"] == np.datetime64("2300-01-01")).collect().num_rows == 0
 
+    def test_sub_tick_literal_orders_correctly(self, session, tmp_path):
+        """A ns-precision literal between two µs column ticks keeps exact
+        ordering answers (lowered to tick+0.5, never equal, orders right)."""
+        d = tmp_path / "us"
+        d.mkdir()
+        ts = np.array(
+            ["2020-01-01T00:00:00.000001", "2020-01-01T00:00:00.000002"],
+            dtype="datetime64[us]",
+        )
+        pq.write_table(pa.table({"ts": pa.array(ts)}), d / "a.parquet")
+        df = session.read.parquet(str(d))
+        mid = np.datetime64("2020-01-01T00:00:00.000001500", "ns")
+        assert df.filter(df["ts"] < mid).collect().num_rows == 1
+        assert df.filter(df["ts"] > mid).collect().num_rows == 1
+        assert df.filter(df["ts"] == mid).collect().num_rows == 0
+        # and an IN list containing it can never match (no float upcast
+        # false positives)
+        assert df.filter(df["ts"].isin(mid)).collect().num_rows == 0
+
     def test_not_unrepresentable_excludes_nulls_both_paths(
         self, session, tmp_path
     ):

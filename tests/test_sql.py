@@ -1,0 +1,135 @@
+"""SQL surface: parse → same IR → same optimizer → index rewrites apply.
+
+The architectural claim mirrors the reference's session extension
+(HyperspaceSparkSessionExtension.scala:44-69): SQL is just another front
+door into the one optimizer, so an index-served DataFrame query and its
+SQL spelling produce the same plan and the same answer.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+
+
+def sorted_table(t):
+    return t.sort_by([(c, "ascending") for c in t.column_names])
+
+
+@pytest.fixture
+def views(session, tmp_path):
+    rng = np.random.default_rng(4)
+    d1 = tmp_path / "items"
+    d1.mkdir()
+    pq.write_table(
+        pa.table(
+            {
+                "k": pa.array(rng.integers(0, 30, 400), type=pa.int64()),
+                "qty": pa.array(rng.integers(1, 10, 400), type=pa.int64()),
+                "tag": pa.array([["red", "blue", "green"][i % 3] for i in range(400)]),
+            }
+        ),
+        d1 / "a.parquet",
+    )
+    d2 = tmp_path / "dims"
+    d2.mkdir()
+    pq.write_table(
+        pa.table(
+            {
+                "dk": pa.array(np.arange(30), type=pa.int64()),
+                "w": pa.array(rng.normal(size=30)),
+            }
+        ),
+        d2 / "a.parquet",
+    )
+    items = session.read.parquet(str(d1))
+    dims = session.read.parquet(str(d2))
+    items.create_or_replace_temp_view("items")
+    dims.create_or_replace_temp_view("dims")
+    return items, dims
+
+
+class TestSqlBasics:
+    def test_select_star_where(self, session, views):
+        out = session.sql("SELECT * FROM items WHERE k = 3").collect()
+        items, _ = views
+        want = items.filter(items["k"] == 3).collect()
+        assert sorted_table(out).equals(sorted_table(want))
+
+    def test_projection_and_operators(self, session, views):
+        out = session.sql(
+            "SELECT k, qty FROM items WHERE qty >= 5 AND tag <> 'red'"
+        ).collect()
+        assert out.column_names == ["k", "qty"]
+        assert all(q >= 5 for q in out.column("qty").to_pylist())
+
+    def test_in_and_null_and_not(self, session, views):
+        out = session.sql(
+            "SELECT k FROM items WHERE k IN (1, 2, 3) AND tag IS NOT NULL"
+        ).collect()
+        assert set(out.column("k").to_pylist()) <= {1, 2, 3}
+
+    def test_group_by_order_limit(self, session, views):
+        out = session.sql(
+            "SELECT tag, SUM(qty) AS total, COUNT(*) AS n FROM items "
+            "GROUP BY tag ORDER BY tag ASC LIMIT 2"
+        ).collect()
+        assert out.column_names == ["tag", "total", "n"]
+        assert out.num_rows == 2
+        assert out.column("tag").to_pylist() == ["blue", "green"]
+
+    def test_join(self, session, views):
+        items, dims = views
+        out = session.sql(
+            "SELECT k, qty, w FROM items JOIN dims ON k = dk WHERE qty > 7"
+        ).collect()
+        want = (
+            items.join(dims, on=items["k"] == dims["dk"])
+            .filter(items["qty"] > 7)
+            .select("k", "qty", "w")
+            .collect()
+        )
+        assert sorted_table(out).equals(sorted_table(want))
+
+    def test_negative_literal(self, session, views):
+        out = session.sql("SELECT k FROM items WHERE k > -1").collect()
+        assert out.num_rows == 400
+
+    def test_not_in_with_null_returns_no_rows(self, session, views):
+        # SQL three-valued logic: x NOT IN (1, NULL) is never TRUE
+        out = session.sql(
+            "SELECT k FROM items WHERE k NOT IN (1, NULL)"
+        ).collect()
+        assert out.num_rows == 0
+        # while plain IN with a NULL still matches the listed value
+        out = session.sql("SELECT k FROM items WHERE k IN (1, NULL)").collect()
+        assert set(out.column("k").to_pylist()) == {1}
+
+    def test_errors(self, session, views):
+        with pytest.raises(HyperspaceException, match="Unknown table"):
+            session.sql("SELECT * FROM nope")
+        with pytest.raises(HyperspaceException, match="GROUP BY"):
+            session.sql("SELECT k, SUM(qty) FROM items")
+        with pytest.raises(HyperspaceException, match="syntax"):
+            session.sql("SELECT k FROM items WHERE k ~ 3")
+
+
+class TestSqlUsesIndexes:
+    def test_sql_filter_is_index_served(self, session, views, tmp_path):
+        items, _ = views
+        hs = Hyperspace(session)
+        hs.create_index(items, CoveringIndexConfig("sqlidx", ["k"], ["qty"]))
+        session.enable_hyperspace()
+        df = session.sql("SELECT k, qty FROM items WHERE k = 7")
+        plan = df.explain()
+        assert "Hyperspace(Type: CI, Name: sqlidx" in plan
+        got = df.collect()
+        session.disable_hyperspace()
+        base = session.sql("SELECT k, qty FROM items WHERE k = 7").collect()
+        assert sorted_table(got).equals(sorted_table(base))
+        assert got.num_rows > 0
