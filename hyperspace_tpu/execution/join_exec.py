@@ -162,6 +162,25 @@ def co_bucketed_join(
     r_pad, r_len, r_rowmap, r_reps = side_arrays(
         r_all, r_sizes, r_offs, [r for _, r in on], 1
     )
+    # PRESORTED fast path: covering-index buckets are key-sorted on disk,
+    # so for single-key joins over clean index scans the combined keys
+    # arrive already monotonic per bucket (pads are +max at the tail).
+    # Re-sorting them on device per query is the single largest serve
+    # cost (measured: 3.5-5.5s of a ~6.5s 4M-row join) — detect
+    # monotonicity in O(n) and binary-search directly. Multi-key combines
+    # (hash, not order-preserving), hybrid-appended tails, null sentinels
+    # and multi-version buckets all fail the check and take the general
+    # sort path; correctness never depends on the hint.
+    from hyperspace_tpu.ops.join import presorted_match_ranges, rows_monotonic
+
+    if rows_monotonic(l_pad) and rows_monotonic(r_pad):
+        perm_l, perm_r, lo, cnt = presorted_match_ranges(
+            l_pad, l_len, r_pad, r_len
+        )
+        return _expand_and_assemble(
+            l_all, r_all, on, l_reps, r_reps,
+            l_rowmap, r_rowmap, l_len, perm_l, perm_r, lo, cnt, z,
+        )
     # pad the bucket dimension so shard_map divides evenly
     if mesh is not None and mesh.devices.size > 1:
         D = mesh.devices.size
@@ -181,6 +200,19 @@ def co_bucketed_join(
     perm_l, perm_r, lo, cnt = bucketed_match_ranges(
         mesh, l_pad, l_len, r_pad, r_len, device_min_rows
     )
+    return _expand_and_assemble(
+        l_all, r_all, on, l_reps, r_reps,
+        l_rowmap, r_rowmap, l_len, perm_l, perm_r, lo, cnt, z,
+    )
+
+
+def _expand_and_assemble(
+    l_all, r_all, on, l_reps, r_reps,
+    l_rowmap, r_rowmap, l_len, perm_l, perm_r, lo, cnt, z,
+):
+    """Expand per-bucket match ranges into row pairs (O(matches),
+    vectorized), re-verify keys exactly, assemble the output batch —
+    shared by the presorted fast path and the general device/host path."""
     li_parts, ri_parts = [], []
     for b in range(len(l_len)):
         total = int(cnt[b].sum())

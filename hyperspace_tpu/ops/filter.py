@@ -160,7 +160,9 @@ class _Prep:
                     vals, self.batch.column(e.child.name).arrow_type
                 )
                 if not lits:
-                    return ("const", False)
+                    # NULL marker survives even when every non-null
+                    # literal lowered away (host twin: unknown rows)
+                    return ("null",) if has_null else ("const", False)
                 arr = np.sort(np.array(lits))
                 if arr.dtype.kind not in "iuf":
                     raise Unsupported(f"IN literal set: {e!r}")

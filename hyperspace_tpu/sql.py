@@ -231,13 +231,21 @@ class _Parser:
                     )
                 group_by = []
             else:
-                missing = [c for c in plain if c.lower() not in (
-                    g.lower() for g in group_by
-                )]
+                by_lower = {g.lower(): g for g in group_by}
+                missing = [c for c in plain if c.lower() not in by_lower]
                 if missing:
                     raise HyperspaceException(
                         f"Columns {missing} must appear in GROUP BY"
                     )
+                # resolve select spellings to the GROUP BY spelling (the
+                # aggregate's actual output column names)
+                items = [
+                    ("col", by_lower[it[1].lower()], it[2])
+                    if it[0] == "col"
+                    else it
+                    for it in items
+                ]
+                cols = [it for it in items if it[0] == "col"]
             specs = []
             for _tag, func, col, alias in aggs:
                 spec = (

@@ -96,6 +96,13 @@ class TestSqlBasics:
         )
         assert sorted_table(out).equals(sorted_table(want))
 
+    def test_group_by_case_insensitive_spelling(self, session, views):
+        out = session.sql(
+            "SELECT Tag, SUM(qty) AS t FROM items GROUP BY tag"
+        ).collect()
+        assert out.column_names == ["tag", "t"]
+        assert out.num_rows == 3
+
     def test_negative_literal(self, session, views):
         out = session.sql("SELECT k FROM items WHERE k > -1").collect()
         assert out.num_rows == 400
