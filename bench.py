@@ -177,6 +177,113 @@ def main() -> None:
             f"unindexed {join_raw * 1e3:.1f}ms ({join_raw / join_idx:.2f}x)"
         )
 
+        # --- Hybrid Scan join (BASELINE config 4 analogue): append ~3%
+        # source rows AFTER indexing; the index must still serve, with the
+        # delta union-compensated and re-bucketed at execution time
+        n_extra = max(n_items // 32, 1)
+        extra = pa.table(
+            {
+                "l_orderkey": np.random.default_rng(9).integers(
+                    0, n_orders, n_extra
+                ),
+                "l_shipdate": pa.array(
+                    np.full(n_extra, np.datetime64("1998-01-01"))
+                ),
+                "l_quantity": np.full(n_extra, 7, dtype=np.int64),
+                "l_extendedprice": np.full(n_extra, 1.0),
+            }
+        )
+        pq.write_table(extra, os.path.join(items_dir, "appended.parquet"))
+        session.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, True)
+        session.index_manager.clear_cache()
+        items2 = session.read.parquet(items_dir)
+        session.enable_hyperspace()
+        plan = q_join(orders, items2).explain()
+        hybrid_served = plan.count("Hyperspace(Type: CI") == 2
+        if not hybrid_served:
+            log(f"WARNING: hybrid join not index-served:\n{plan}")
+        h_rows = q_join(orders, items2).collect().num_rows
+        hybrid_idx = p50(lambda: q_join(orders, items2).collect(), reps)
+        session.disable_hyperspace()
+        assert q_join(orders, items2).collect().num_rows == h_rows
+        hybrid_raw = p50(lambda: q_join(orders, items2).collect(), reps)
+        log(
+            f"hybrid-scan join p50: indexed {hybrid_idx * 1e3:.1f}ms vs "
+            f"unindexed {hybrid_raw * 1e3:.1f}ms ({hybrid_raw / hybrid_idx:.2f}x)"
+        )
+        session.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, False)
+
+        # --- Delta incremental refresh (BASELINE config 5): index a Delta
+        # table with lineage, commit appends, time the incremental refresh
+        import json as _json
+
+        delta_dir = os.path.join(tmp, "delta_tbl")
+        dlog = os.path.join(delta_dir, "_delta_log")
+        os.makedirs(dlog)
+        rngd = np.random.default_rng(13)
+        n_delta = max(n_items // 4, 1)
+
+        def delta_file(name, rows):
+            t = pa.table(
+                {
+                    "k": rngd.integers(0, n_orders, rows),
+                    "q": rngd.integers(1, 51, rows),
+                }
+            )
+            fp = os.path.join(delta_dir, name)
+            pq.write_table(t, fp)
+            st = os.stat(fp)
+            return {
+                "path": name,
+                "size": st.st_size,
+                "modificationTime": int(st.st_mtime * 1000),
+                "dataChange": True,
+            }
+
+        schema_str = _json.dumps(
+            {
+                "type": "struct",
+                "fields": [
+                    {"name": "k", "type": "long", "nullable": True, "metadata": {}},
+                    {"name": "q", "type": "long", "nullable": True, "metadata": {}},
+                ],
+            }
+        )
+        with open(os.path.join(dlog, f"{0:020d}.json"), "w") as f:
+            f.write(_json.dumps({"protocol": {"minReaderVersion": 1, "minWriterVersion": 2}}) + "\n")
+            f.write(
+                _json.dumps(
+                    {
+                        "metaData": {
+                            "id": "bench",
+                            "schemaString": schema_str,
+                            "partitionColumns": [],
+                            "format": {"provider": "parquet"},
+                        }
+                    }
+                )
+                + "\n"
+            )
+            f.write(_json.dumps({"add": delta_file("part-0.parquet", n_delta)}) + "\n")
+        from hyperspace_tpu.indexes.covering import CoveringIndexConfig as CIC
+
+        session.conf.set(C.INDEX_LINEAGE_ENABLED, True)
+        ddf = session.read.delta(delta_dir)
+        hs.create_index(ddf, CIC("delta_idx", ["k"], ["q"]))
+        n_append = max(n_delta // 8, 1)
+        with open(os.path.join(dlog, f"{1:020d}.json"), "w") as f:
+            f.write(
+                _json.dumps({"add": delta_file("part-1.parquet", n_append)}) + "\n"
+            )
+        session.index_manager.clear_cache()
+        t0 = time.perf_counter()
+        hs.refresh_index("delta_idx", C.REFRESH_MODE_INCREMENTAL)
+        delta_refresh = time.perf_counter() - t0
+        log(
+            f"delta incremental refresh of {n_append:,} appended rows: "
+            f"{delta_refresh:.2f}s ({n_append / delta_refresh:,.0f} rows/s)"
+        )
+
         speedup = join_raw / join_idx
         print(
             json.dumps(
@@ -197,6 +304,12 @@ def main() -> None:
                     "join_indexed_p50_ms": round(join_idx * 1e3, 2),
                     "join_unindexed_p50_ms": round(join_raw * 1e3, 2),
                     "join_rows_out": j_rows,
+                    "hybrid_join_indexed_p50_ms": round(hybrid_idx * 1e3, 2),
+                    "hybrid_join_unindexed_p50_ms": round(hybrid_raw * 1e3, 2),
+                    "hybrid_join_speedup": round(hybrid_raw / hybrid_idx, 3),
+                    "hybrid_index_served": hybrid_served,
+                    "delta_incr_refresh_s": round(delta_refresh, 3),
+                    "delta_refresh_rows_per_sec": round(n_append / delta_refresh),
                 }
             )
         )

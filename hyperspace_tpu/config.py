@@ -68,6 +68,16 @@ class Config:
         return self.get_int(C.INDEX_NUM_BUCKETS, C.INDEX_NUM_BUCKETS_DEFAULT)
 
     @property
+    def profile_trace_dir(self) -> str:
+        return self.get_str(C.PROFILE_TRACE_DIR, C.PROFILE_TRACE_DIR_DEFAULT)
+
+    @property
+    def explain_display_mode(self) -> str:
+        return self.get_str(
+            C.EXPLAIN_DISPLAY_MODE, C.EXPLAIN_DISPLAY_MODE_DEFAULT
+        )
+
+    @property
     def build_memory_budget(self) -> int:
         """Max bytes materialized per build wave (0 = unbounded)."""
         return self.get_int(

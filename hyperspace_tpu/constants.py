@@ -86,6 +86,16 @@ DEFAULT_SUPPORTED_FORMATS = "hyperspace.index.sources.defaultSupportedFormats"
 # reference default: DefaultFileBasedSource.scala:76-85
 DEFAULT_SUPPORTED_FORMATS_DEFAULT = "avro,csv,json,orc,parquet,text"
 
+# Observability: when set, every session.execute runs under an XLA
+# profiler trace written to this directory (TensorBoard/Perfetto format).
+# SURVEY §5 calls for profiler integration on top of the typed event bus.
+PROFILE_TRACE_DIR = "hyperspace.profile.traceDir"
+PROFILE_TRACE_DIR_DEFAULT = ""
+
+# Explain rendering (DisplayMode.scala: plaintext / console / html)
+EXPLAIN_DISPLAY_MODE = "hyperspace.explain.displayMode"
+EXPLAIN_DISPLAY_MODE_DEFAULT = "plaintext"
+
 # Streaming build: cap the bytes materialized per wave of the covering
 # index build (0 = unbounded, one in-memory pass). The reference gets
 # disk-backed spill for free from Spark's shuffle
@@ -152,5 +162,9 @@ INDEX_FILE_PREFIX = "part"
 # the device kernel; below this the host twin of the same algorithm wins
 # because per-dispatch + transfer latency dominates (very pronounced on a
 # tunneled chip; still real on PCIe).
+# Single-device join matching runs on host by default (measured ~10x
+# faster than the device sort+transfer round trip on one chip; a >1-device
+# mesh always uses the sharded device program). Set a positive row count
+# to force the device program on a single device once total rows reach it.
 EXECUTION_DEVICE_JOIN_MIN_ROWS = "hyperspace.execution.deviceJoinMinRows"
-EXECUTION_DEVICE_JOIN_MIN_ROWS_DEFAULT = 2_000_000
+EXECUTION_DEVICE_JOIN_MIN_ROWS_DEFAULT = 0  # 0 = never on single device

@@ -78,11 +78,12 @@ class Hyperspace:
             raise HyperspaceException(f"Index not found: {index_name!r}")
         return index_stats_table(entry)
 
-    def explain(self, df, verbose: bool = False) -> str:
-        """Plan diff with vs without Hyperspace (PlanAnalyzer.explainString)."""
+    def explain(self, df, verbose: bool = False, mode: str = None) -> str:
+        """Plan diff with vs without Hyperspace (PlanAnalyzer.explainString).
+        ``mode``: plaintext (default) / console (ANSI highlight) / html."""
         from hyperspace_tpu.plananalysis.explain import explain_string
 
-        return explain_string(df, self.session, self._manager, verbose)
+        return explain_string(df, self.session, self._manager, verbose, mode)
 
     def why_not(
         self, df, index_name: Optional[str] = None, extended: bool = False

@@ -12,23 +12,65 @@ from collections import Counter
 from typing import Dict, List, Tuple
 
 from hyperspace_tpu.constants import States
+from hyperspace_tpu.exceptions import HyperspaceException
 
 _BAR = "=" * 65
-_HL_OPEN = "<----"
-_HL_CLOSE = "---->"
 
 
-def _highlighted_plan(plan, changed_scans) -> str:
-    """Pretty plan string with changed Scan lines wrapped in highlight
-    markers (the reference's BufferStream highlight tags)."""
+class DisplayMode:
+    """Explain rendering mode (reference: ``plananalysis/DisplayMode.scala``
+    — PlainText / Console / HTML variants differing in the highlight tags
+    wrapped around index scans and in newline/escape handling)."""
+
+    name = "plaintext"
+    highlight_open = "<----"
+    highlight_close = "---->"
+    newline = "\n"
+
+    def escape(self, text: str) -> str:
+        return text
+
+
+class ConsoleMode(DisplayMode):
+    name = "console"
+    highlight_open = "\x1b[93m"  # bright yellow
+    highlight_close = "\x1b[0m"
+
+
+class HTMLMode(DisplayMode):
+    name = "html"
+    highlight_open = "<b>"
+    highlight_close = "</b>"
+    newline = "<br/>"
+
+    def escape(self, text: str) -> str:
+        return (
+            text.replace("&", "&amp;").replace("<", "&lt;").replace(">", "&gt;")
+        )
+
+
+_MODES = {m.name: m for m in (DisplayMode, ConsoleMode, HTMLMode)}
+
+
+def get_display_mode(name: str) -> DisplayMode:
+    cls = _MODES.get(name.lower())
+    if cls is None:
+        raise HyperspaceException(
+            f"Unknown explain display mode {name!r}; one of {sorted(_MODES)}"
+        )
+    return cls()
+
+
+def _highlighted_plan(plan, changed_scans, mode: DisplayMode) -> str:
+    """Pretty plan string with changed Scan lines wrapped in the mode's
+    highlight tags (the reference's BufferStream highlight tags)."""
     lines = []
 
     def walk(node, indent):
-        text = "  " * indent + node._node_string()
+        text = mode.escape(node._node_string())
         if node in changed_scans:
-            text = f"{_HL_OPEN}{text.lstrip()}{_HL_CLOSE}"
-            text = "  " * indent + text
-        lines.append(text)
+            text = f"{mode.highlight_open}{text}{mode.highlight_close}"
+        lines.append("  " * indent + text)
         for c in node.children:
             walk(c, indent + 1)
 
@@ -67,9 +109,14 @@ def _operator_diff_table(with_plan, without_plan) -> str:
     return "\n".join(out)
 
 
-def explain_string(df, session, manager, verbose: bool = False) -> str:
+def explain_string(
+    df, session, manager, verbose: bool = False, mode: str = None
+) -> str:
     """PlanAnalyzer.explainString: optimize the plan with the rule enabled
-    and render the diff against the unoptimized plan."""
+    and render the diff against the unoptimized plan. ``mode`` overrides
+    the session's ``hyperspace.explain.displayMode`` conf (plaintext /
+    console / html)."""
+    dm = get_display_mode(mode or session.conf.explain_display_mode)
     original = df.logical_plan
     prev = session.is_hyperspace_enabled()
     try:
@@ -89,12 +136,12 @@ def explain_string(df, session, manager, verbose: bool = False) -> str:
         _BAR,
         "Plan with indexes:",
         _BAR,
-        _highlighted_plan(optimized, set(used_scans)),
+        _highlighted_plan(optimized, set(used_scans), dm),
         "",
         _BAR,
         "Plan without indexes:",
         _BAR,
-        original.pretty(),
+        dm.escape(original.pretty()),
         "",
         _BAR,
         "Indexes used:",
@@ -128,4 +175,5 @@ def explain_string(df, session, manager, verbose: bool = False) -> str:
         if not active:
             buf.append("(none)")
         buf.append("")
-    return "\n".join(buf)
+    # identity when dm.newline == "\n"; re-joins per-line for html's <br/>
+    return dm.newline.join(line for chunk in buf for line in chunk.split("\n"))

@@ -181,4 +181,12 @@ class HyperspaceSession:
     def execute(self, plan) -> pa.Table:
         from hyperspace_tpu.execution import execute
 
+        trace_dir = self.conf.profile_trace_dir
+        if trace_dir:
+            # XLA profiler integration (SURVEY §5): device kernels, host
+            # callbacks and transfers land in a TensorBoard/Perfetto trace
+            import jax
+
+            with jax.profiler.trace(trace_dir):
+                return execute(self.optimize(plan), self)
         return execute(self.optimize(plan), self)

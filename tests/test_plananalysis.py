@@ -64,6 +64,72 @@ class TestExplain:
         assert session.is_hyperspace_enabled()
 
 
+class TestDisplayModes:
+    def _q(self, hs, df):
+        hs.create_index(df, CoveringIndexConfig("dm_idx", ["clicks"], ["query"]))
+        return df.filter(df["clicks"] >= 100).select("clicks", "query")
+
+    def test_console_mode_ansi_highlight(self, session, hs, df):
+        q = self._q(hs, df)
+        out = hs.explain(q, mode="console")
+        assert "\x1b[93m" in out and "\x1b[0m" in out
+        assert "dm_idx" in out
+
+    def test_html_mode_escapes_and_bolds(self, session, hs, df):
+        q = self._q(hs, df)
+        out = hs.explain(q, mode="html")
+        assert "<b>" in out and "</b>" in out and "<br/>" in out
+        # plan text angle brackets are escaped, tags are not
+        assert "&gt;=" in out  # the >= in the filter condition
+
+    def test_mode_from_conf(self, session, hs, df):
+        q = self._q(hs, df)
+        session.conf.set(C.EXPLAIN_DISPLAY_MODE, "console")
+        assert "\x1b[93m" in hs.explain(q)
+
+    def test_unknown_mode_rejected(self, session, hs, df):
+        from hyperspace_tpu.exceptions import HyperspaceException
+
+        q = self._q(hs, df)
+        with pytest.raises(HyperspaceException, match="display mode"):
+            hs.explain(q, mode="nope")
+
+    def test_explain_golden(self, session, hs, df, sample_parquet):
+        """Golden-file protection for the explain output format
+        (reference: per-version expected/*.txt fixtures, ExplainTest)."""
+        import os
+        import re
+
+        q = self._q(hs, df)
+        out = hs.explain(q)
+        norm = out.replace(sample_parquet, "<src>")
+        norm = re.sub(r"LogVersion: \d+", "LogVersion: N", norm)
+        norm = re.sub(r"\(v\d+\): \S+", "(vN): <index-path>", norm)
+        golden = os.path.join(
+            os.path.dirname(__file__), "goldstandard", "explain_filter.txt"
+        )
+        if os.environ.get("HS_GENERATE_GOLDEN_FILES") == "1":
+            with open(golden, "w") as f:
+                f.write(norm)
+            pytest.skip("golden regenerated")
+        with open(golden) as f:
+            assert norm == f.read()
+
+
+class TestProfilerIntegration:
+    def test_trace_dir_produces_trace(self, session, df, tmp_path):
+        trace_dir = str(tmp_path / "trace")
+        session.conf.set(C.PROFILE_TRACE_DIR, trace_dir)
+        df.filter(df["clicks"] >= 100).select("clicks").collect()
+        session.conf.set(C.PROFILE_TRACE_DIR, "")
+        import os
+
+        found = []
+        for root, _dirs, files in os.walk(trace_dir):
+            found.extend(files)
+        assert found, "no profiler trace files written"
+
+
 class TestWhyNot:
     def test_why_not_reports_reasons(self, session, hs, df):
         hs.create_index(df, CoveringIndexConfig("cl_idx", ["clicks"], ["query"]))
