@@ -5,13 +5,13 @@ Generic (un-indexed) joins sort-merge on int64 key reps
 shard pair without any shuffle — the payoff the reference gets from
 bucketed indexes + SMJ (``covering/JoinIndexRule.scala:619-634``).
 
-Matching uses a grouped merge: both sides' composite keys are mapped to
-dense group ids (``np.unique`` over the rep rows — exact, no collisions at
-the rep level), then pairs are expanded per group arithmetically
-(vectorized, no Python loop). Reps are exact for numeric keys; for string
-keys two different strings could share a rep only on a murmur3-64
-collision, so string key columns are re-verified via dictionary remapping
-(O(unique), vectorized).
+Matching combines each row's keys into one int64 (identity for a single
+key, splitmix64 mix for composites), argsorts the right side once, and
+binary-searches from the left; pairs are expanded per match range
+arithmetically (vectorized, no Python loop). Single-key matching is
+rep-exact; composite combines can collide, so multi-key joins re-verify
+the numeric key columns, and string key columns are always re-verified
+via dictionary remapping (murmur3-64 rep collisions), both O(matches).
 """
 
 from __future__ import annotations
@@ -26,21 +26,28 @@ from hyperspace_tpu.io.columnar import ColumnarBatch
 def merge_join_indices(
     l_reps: np.ndarray, r_reps: np.ndarray
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """[k, n] and [k, m] int64 reps -> (left_idx, right_idx) of all matching
-    pairs, ordered by left row."""
+    """[k, n] and [k, m] int64 reps -> (left_idx, right_idx) of matching
+    pairs, ordered by left row.
+
+    Matches on the COMBINED per-row key (identity for k == 1, splitmix64
+    mix for k > 1): one argsort of the right side + binary search from the
+    left — measured several times faster than the previous
+    ``np.unique(axis=0)`` void-view grouping at millions of rows. For
+    k > 1 the combine can collide, so pairs are superset-exact and the
+    caller MUST re-verify key columns (``inner_join`` does)."""
+    from hyperspace_tpu.ops.join import combine_reps_np
+
     n, m = l_reps.shape[1], r_reps.shape[1]
     if n == 0 or m == 0:
         z = np.zeros(0, dtype=np.int64)
         return z, z
-    both = np.concatenate([l_reps.T, r_reps.T])
-    _uniq, inv = np.unique(both, axis=0, return_inverse=True)
-    inv = inv.ravel()
-    gl, gr = inv[:n], inv[n:]
-    num_groups = int(inv.max()) + 1
-    order_r = np.argsort(gr, kind="stable")
-    counts_r = np.bincount(gr, minlength=num_groups)
-    offsets_r = np.concatenate([[0], np.cumsum(counts_r)[:-1]])
-    cnt = counts_r[gl]
+    l1 = combine_reps_np(l_reps)
+    r1 = combine_reps_np(r_reps)
+    order_r = np.argsort(r1, kind="stable")
+    rs = r1[order_r]
+    lo = np.searchsorted(rs, l1, side="left")
+    hi = np.searchsorted(rs, l1, side="right")
+    cnt = hi - lo
     total = int(cnt.sum())
     if total == 0:
         z = np.zeros(0, dtype=np.int64)
@@ -48,7 +55,7 @@ def merge_join_indices(
     li = np.repeat(np.arange(n, dtype=np.int64), cnt)
     starts = np.concatenate([[0], np.cumsum(cnt)[:-1]])
     within = np.arange(total, dtype=np.int64) - np.repeat(starts, cnt)
-    ri = order_r[np.repeat(offsets_r[gl], cnt) + within]
+    ri = order_r[np.repeat(lo, cnt) + within]
     return li, ri
 
 
@@ -274,7 +281,10 @@ def inner_join(
     r_map = np.nonzero(r_ok)[0]
     li, ri = merge_join_indices(l_reps[:, l_ok], r_reps[:, r_ok])
     li, ri = l_map[li], r_map[ri]
-    # matching was rep-exact (np.unique over full rep rows), so only the
-    # string hash-collision guard is needed
-    li, ri = _verify_keys(left, right, on, li, ri, verify_numeric=False)
+    # k == 1 matching is rep-exact (identity combine): only the string
+    # hash-collision guard is needed; k > 1 combines can collide, so the
+    # numeric columns are re-verified too
+    li, ri = _verify_keys(
+        left, right, on, li, ri, l_reps, r_reps, verify_numeric=len(on) > 1
+    )
     return _assemble(left, right, li, ri)
