@@ -137,6 +137,13 @@ class Config:
         return [s.strip() for s in raw.split(",") if s.strip()]
 
     @property
+    def device_filter_min_rows(self) -> int:
+        return self.get_int(
+            C.EXECUTION_DEVICE_FILTER_MIN_ROWS,
+            C.EXECUTION_DEVICE_FILTER_MIN_ROWS_DEFAULT,
+        )
+
+    @property
     def device_join_min_rows(self) -> int:
         return self.get_int(
             C.EXECUTION_DEVICE_JOIN_MIN_ROWS,

@@ -162,6 +162,15 @@ INDEX_FILE_PREFIX = "part"
 # the device kernel; below this the host twin of the same algorithm wins
 # because per-dispatch + transfer latency dominates (very pronounced on a
 # tunneled chip; still real on PCIe).
+# Predicate evaluation dispatches to the XLA kernel only at/above this
+# row count. Serve-path batches come out of host parquet reads, so the
+# mask pays host->device transfer + readback before any compute —
+# measured ~100ms for a 500k-row bucket through the tunnel vs ~2ms of
+# host numpy. Data already resident in HBM (mesh-sharded serve) is a
+# different regime; lower this to force the device kernel.
+EXECUTION_DEVICE_FILTER_MIN_ROWS = "hyperspace.execution.deviceFilterMinRows"
+EXECUTION_DEVICE_FILTER_MIN_ROWS_DEFAULT = 8_000_000
+
 # Single-device join matching runs on host by default (measured ~10x
 # faster than the device sort+transfer round trip on one chip; a >1-device
 # mesh always uses the sharded device program). Set a positive row count

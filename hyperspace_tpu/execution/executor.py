@@ -43,8 +43,16 @@ def _exec(plan: LogicalPlan, needed: Set[str], session) -> ColumnarBatch:
     if isinstance(plan, Filter):
         child = _bucket_pruned_scan(plan.child, plan.condition)
         child_needed = set(needed) | E.references(plan.condition)
-        batch = _exec(child, child_needed, session)
-        return batch.filter(_filter_mask(plan.condition, batch))
+        if isinstance(child, Scan):
+            batch = _exec_scan(
+                child,
+                child_needed,
+                session,
+                pushdown=_pushdown_filters(plan.condition, child.relation),
+            )
+        else:
+            batch = _exec(child, child_needed, session)
+        return batch.filter(_filter_mask(plan.condition, batch, session))
     if isinstance(plan, Project):
         batch = _exec(plan.child, set(plan.columns), session)
         return batch.select(plan.columns)
@@ -297,6 +305,91 @@ def _bucket_pruned_scan(plan: LogicalPlan, cond: E.Expr) -> LogicalPlan:
     return Scan(dataclasses.replace(rel, files=kept))
 
 
+def _pushable_literal(value, arrow_type):
+    """Literal in a form pyarrow's parquet filters accept for a column of
+    ``arrow_type``, or None when it must not be pushed (type-mismatched
+    literals would make the dataset filter error at read time; the
+    engine's own mask treats them as never-matching instead)."""
+    import pyarrow as pa
+
+    if value is None or arrow_type is None:
+        return None
+    if isinstance(value, (np.integer, np.floating, np.bool_)):
+        value = value.item()
+    if pa.types.is_temporal(arrow_type):
+        if getattr(arrow_type, "tz", None) is not None:
+            # tz-aware columns: arrow refuses naive-vs-aware comparisons
+            return None
+        # only literals exactly representable in the column type: ±inf
+        # clamps and between-tick values would overflow/err in arrow's cast
+        if not isinstance(E.lower_literal(value, arrow_type), np.int64):
+            return None
+        return E.normalize_temporal_literal(value, arrow_type)
+    if pa.types.is_boolean(arrow_type):
+        return value if isinstance(value, bool) else None
+    if pa.types.is_integer(arrow_type) or pa.types.is_floating(arrow_type):
+        if isinstance(value, bool):
+            return int(value)  # engine: flag == True matches 1
+        if isinstance(value, int):
+            # arrow converts through C long: out-of-int64-range literals
+            # raise there; the engine treats them as never-matching
+            if not (-(2**63) <= value < 2**63):
+                return None
+            return value
+        return value if isinstance(value, float) else None
+    t = arrow_type
+    if pa.types.is_dictionary(t):
+        t = t.value_type
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return value if isinstance(value, str) else None
+    return None
+
+
+def _pushdown_filters(cond: E.Expr, rel):
+    """Pyarrow DNF filter (single conjunction) for parquet ROW-GROUP
+    pruning from the predicate's simple conjuncts.
+
+    Sound by construction: only conjuncts whose pyarrow evaluation keeps a
+    superset of the rows the engine's own mask keeps are pushed (plain
+    col-op-literal and IN; null/NaN drop the same rows in both engines),
+    and the executor re-applies the full mask after the read. On a
+    key-sorted index bucket this turns a point lookup into a read of the
+    one row group whose min/max covers the key.
+    """
+    if rel.fmt not in ("parquet", "delta", "iceberg"):
+        return None
+    cols = {c.lower(): c for c in rel.column_names}
+    out = []
+    for cj in E.split_conjuncts(cond):
+        norm = E.normalize_comparison(cj)
+        if norm is not None:
+            op, name, lit = norm
+            col = cols.get(name.lower())
+            if col is None:
+                continue
+            lit = _pushable_literal(lit, rel.schema[col])
+            if lit is None:
+                continue
+            out.append((col, op if op != "=" else "==", lit))
+        elif isinstance(cj, E.In) and isinstance(cj.child, E.Col):
+            col = cols.get(cj.child.name.lower())
+            if col is None:
+                continue
+            vals = [
+                lv
+                for v in cj.values
+                if v is not None
+                for lv in [_pushable_literal(v, rel.schema[col])]
+                if lv is not None
+            ]
+            if not vals or len(vals) != len(
+                [v for v in cj.values if v is not None]
+            ):
+                continue  # partial lists would under-keep: skip
+            out.append((col, "in", vals))
+    return out or None
+
+
 def _bucket_layout(plan: LogicalPlan):
     """(num_buckets, bucket_cols) if the subtree preserves a bucketed scan
     layout (Scan with bucket_spec under Filter/Project/Union)."""
@@ -395,7 +488,7 @@ def _exec_bucketed(
         for b, batch in _exec_bucketed(
             plan.child, child_needed, session, bucket_cols
         ).items():
-            out[b] = batch.filter(_filter_mask(plan.condition, batch))
+            out[b] = batch.filter(_filter_mask(plan.condition, batch, session))
         return out
     if isinstance(plan, Project):
         cols = [c for c in plan.columns if c in needed] or plan.columns
@@ -433,14 +526,29 @@ def _exec_bucketed(
     )
 
 
-def _filter_mask(cond: E.Expr, batch: ColumnarBatch) -> np.ndarray:
+def _filter_mask(
+    cond: E.Expr, batch: ColumnarBatch, session=None
+) -> np.ndarray:
+    from hyperspace_tpu import constants as C
+
+    min_rows = (
+        session.conf.device_filter_min_rows
+        if session is not None
+        else C.EXECUTION_DEVICE_FILTER_MIN_ROWS_DEFAULT
+    )
+    if batch.num_rows < min_rows:
+        # host-resident batch below the device threshold: numpy beats the
+        # host->device->host round trip (see constants.py rationale)
+        return E.filter_mask(cond, batch)
     try:
         return device_filter_mask(cond, batch)
     except Unsupported:
         return E.filter_mask(cond, batch)
 
 
-def _exec_scan(plan: Scan, needed: Set[str], session) -> ColumnarBatch:
+def _exec_scan(
+    plan: Scan, needed: Set[str], session, pushdown=None
+) -> ColumnarBatch:
     rel = plan.relation
     cols = [c for c in rel.column_names if c in needed] or rel.column_names[:1]
     read_cols = list(cols)
@@ -458,7 +566,7 @@ def _exec_scan(plan: Scan, needed: Set[str], session) -> ColumnarBatch:
             {c: pa.array([], type=rel.schema[c]) for c in cols}
         )
         return ColumnarBatch.from_arrow(empty)
-    table = pio.read_table(list(rel.files), read_cols, rel.fmt)
+    table = pio.read_table(list(rel.files), read_cols, rel.fmt, filters=pushdown)
     batch = ColumnarBatch.from_arrow(table)
     if rel.excluded_file_ids is not None:
         lineage = batch.column(DATA_FILE_NAME_ID).values

@@ -51,10 +51,16 @@ def file_row_counts(paths: Sequence[str]) -> List[int]:
 
 
 def read_table(
-    paths: Sequence[str], columns: Optional[Sequence[str]] = None, fmt: str = "parquet"
+    paths: Sequence[str],
+    columns: Optional[Sequence[str]] = None,
+    fmt: str = "parquet",
+    filters=None,
 ) -> pa.Table:
     """Read and concatenate files into one Arrow table (row order follows
-    ``paths`` order, file by file)."""
+    ``paths`` order, file by file). ``filters`` (parquet-like formats
+    only) is a pyarrow DNF conjunction used for ROW-GROUP pruning — the
+    executor re-applies its own mask afterwards, so filters only need to
+    keep a superset of matching rows."""
     if fmt in ("parquet", "delta", "iceberg") and len(paths) > 1:
         # One threaded dataset read beats N sequential reads ~3x and pyarrow
         # preserves the given file order — but it locks the first file's
@@ -64,12 +70,20 @@ def read_table(
         schemas = _file_schemas(paths)
         if all(s.equals(schemas[0]) for s in schemas[1:]):
             return pq.read_table(
-                list(paths), columns=list(columns) if columns else None
+                list(paths),
+                columns=list(columns) if columns else None,
+                filters=filters,
             )
     tables = []
     for p in paths:
         if fmt in ("parquet", "delta", "iceberg"):  # lake data files ARE parquet
-            tables.append(pq.read_table(p, columns=list(columns) if columns else None))
+            tables.append(
+                pq.read_table(
+                    p,
+                    columns=list(columns) if columns else None,
+                    filters=filters,
+                )
+            )
         elif fmt == "csv":
             t = pacsv.read_csv(p)
             tables.append(t.select(list(columns)) if columns else t)
@@ -218,6 +232,14 @@ def bucket_runs(bucket_ids: np.ndarray):
         yield int(sorted_ids[s]), np.sort(order[s:e])
 
 
+# Row-group size for index data files. Bucket files are KEY-SORTED, so
+# each row group's min/max statistics cover a narrow key range — the
+# serve-side predicate pushdown (executor._pushdown_filters) then reads
+# only the row group(s) a point lookup can touch. Smaller groups prune
+# tighter but cost more metadata; 64k rows balances both.
+INDEX_ROW_GROUP_SIZE = 1 << 16
+
+
 def write_bucket_files(
     out_dir: str,
     bucket_ids: np.ndarray,
@@ -232,7 +254,9 @@ def write_bucket_files(
     written = []
     for b, idx in bucket_runs(bucket_ids):
         path = os.path.join(out_dir, bucket_file_name(file_idx_offset + b, b))
-        pq.write_table(table.take(pa.array(idx)), path)
+        pq.write_table(
+            table.take(pa.array(idx)), path, row_group_size=INDEX_ROW_GROUP_SIZE
+        )
         written.append(path)
     return written
 

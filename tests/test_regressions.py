@@ -133,6 +133,98 @@ class TestBooleanAggregates:
         assert out.column("hi").to_pylist() == [True]
 
 
+class TestRowGroupPushdown:
+    def test_point_filter_pushes_and_matches(self, session, tmp_path, monkeypatch):
+        """Simple conjuncts reach pq.read_table as DNF filters (row-group
+        pruning on key-sorted index files) and the answer is unchanged."""
+        from hyperspace_tpu.hyperspace import Hyperspace
+        from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+        from hyperspace_tpu.io import parquet as pio
+
+        d = tmp_path / "push"
+        d.mkdir()
+        rng = np.random.default_rng(6)
+        pq.write_table(
+            pa.table(
+                {
+                    "k": pa.array(rng.integers(0, 100, 2000), pa.int64()),
+                    "v": pa.array(rng.normal(size=2000)),
+                }
+            ),
+            d / "a.parquet",
+        )
+        hs = Hyperspace(session)
+        df = session.read.parquet(str(d))
+        hs.create_index(df, CoveringIndexConfig("pidx", ["k"], ["v"]))
+        session.enable_hyperspace()
+
+        captured = []
+        real = pio.read_table
+
+        def capture(paths, columns=None, fmt="parquet", filters=None):
+            captured.append(filters)
+            return real(paths, columns, fmt, filters)
+
+        monkeypatch.setattr(
+            "hyperspace_tpu.execution.executor.pio.read_table", capture
+        )
+        q = df.filter(df["k"] == 42).select("k", "v")
+        got = q.collect()
+        assert any(
+            f is not None and ("k", "==", 42) in f for f in captured
+        ), captured
+        session.disable_hyperspace()
+        base = q.collect()
+        key = lambda t: t.sort_by([(c, "ascending") for c in t.column_names])
+        assert key(got).equals(key(base)) and got.num_rows > 0
+
+    def test_unsafe_literals_not_pushed(self, session, tmp_path):
+        from hyperspace_tpu.execution.executor import _pushdown_filters
+        from hyperspace_tpu.plan import expressions as E
+
+        d = tmp_path / "np2"
+        d.mkdir()
+        pq.write_table(
+            pa.table({"s": pa.array(["a", "b"]), "x": pa.array([1, 2])}),
+            d / "a.parquet",
+        )
+        rel = session.read.parquet(str(d)).logical_plan.relation
+        # type-mismatched literal on a string column must not be pushed
+        assert _pushdown_filters(E.Col("s") == 5, rel) is None
+        # null literal must not be pushed
+        assert _pushdown_filters(E.Col("x") == None, rel) is None  # noqa: E711
+        # valid one is
+        assert _pushdown_filters(E.Col("s") == "a", rel) == [("s", "==", "a")]
+        # out-of-int64-range int must not be pushed (arrow OverflowError)
+        assert _pushdown_filters(E.Col("x") == 2**70, rel) is None
+        # bool literal on an int column pushes as its integer value
+        assert _pushdown_filters(E.Col("x") == True, rel) == [  # noqa: E712
+            ("x", "==", 1)
+        ]
+
+    def test_overflow_bool_and_tz_literals_end_to_end(self, session, tmp_path):
+        import datetime
+
+        d = tmp_path / "np3"
+        d.mkdir()
+        ts = pa.array(
+            [datetime.datetime(2020, 1, 1), datetime.datetime(2021, 1, 1)],
+            type=pa.timestamp("us", tz="UTC"),
+        )
+        pq.write_table(
+            pa.table({"k": pa.array([0, 1], type=pa.int64()), "t": ts}),
+            d / "a.parquet",
+        )
+        df = session.read.parquet(str(d))
+        assert df.filter(df["k"] == 2**70).collect().num_rows == 0
+        assert df.filter(df["k"] == True).collect().num_rows == 1  # noqa: E712
+        # tz-aware column: no push, engine lowers and matches
+        got = df.filter(
+            df["t"] == datetime.datetime(2020, 1, 1)
+        ).collect()
+        assert got.num_rows == 1
+
+
 class TestNaNMinMaxSketch:
     def test_nan_does_not_skip_matching_file(self, session, tmp_path):
         """A NaN in a float column must not poison the file's min/max
@@ -301,9 +393,9 @@ class TestLimitPushdown:
         seen = []
         real = pio.read_table
 
-        def counting(paths, columns=None, fmt="parquet"):
+        def counting(paths, columns=None, fmt="parquet", filters=None):
             seen.extend(paths)
-            return real(paths, columns, fmt)
+            return real(paths, columns, fmt, filters)
 
         monkeypatch.setattr(
             "hyperspace_tpu.execution.executor.pio.read_table", counting

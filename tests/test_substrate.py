@@ -187,3 +187,24 @@ class TestShuffle:
         payload = np.arange(n, dtype=np.int64) * 1000
         _, (k_out, p_out) = bucket_shuffle(mesh, keys, [keys[0], payload], 4)
         np.testing.assert_array_equal(k_out * 1000, p_out)
+
+
+def test_bucket_ids_host_device_bit_exact():
+    """The small-input host hash and the device kernel must agree
+    bit-for-bit (build uses device at scale, pruning uses host)."""
+    import numpy as np
+
+    from hyperspace_tpu.ops import hash as H
+
+    rng = np.random.default_rng(0)
+    reps = rng.integers(-(2**62), 2**62, size=(2, 3000), dtype=np.int64)
+    host = H.bucket_ids_np(reps, 16)
+    assert len(host) == 3000
+    # force the device path by lowering the threshold
+    old = H._HOST_HASH_MAX_ROWS
+    try:
+        H._HOST_HASH_MAX_ROWS = 0
+        dev = H.bucket_ids_np(reps, 16)
+    finally:
+        H._HOST_HASH_MAX_ROWS = old
+    assert np.array_equal(host, dev)
