@@ -158,10 +158,6 @@ NESTED_FIELD_PREFIX = "__hs_nested."
 INDEX_FILE_PREFIX = "part"
 
 # -- execution tuning --------------------------------------------------------
-# Minimum total joined rows before the co-bucketed merge join dispatches to
-# the device kernel; below this the host twin of the same algorithm wins
-# because per-dispatch + transfer latency dominates (very pronounced on a
-# tunneled chip; still real on PCIe).
 # Predicate evaluation dispatches to the XLA kernel only at/above this
 # row count. Serve-path batches come out of host parquet reads, so the
 # mask pays host->device transfer + readback before any compute —

@@ -79,6 +79,56 @@ def _as_device(vals: np.ndarray) -> jnp.ndarray:
     return jnp.asarray(vals)
 
 
+# Below this row count the reductions run as plain numpy: the device
+# segment ops pay a host->device->host round trip AND recompile per
+# (row count, group count) pair, while the numpy twins (same null/NaN
+# semantics, exact int64 sums via ufunc.at) finish in milliseconds on
+# host-resident serve batches.
+_HOST_AGG_MAX_ROWS = 1 << 20
+
+
+def _host_sum_count(gid, vals, valid, num_segments):
+    # accumulate in the same widened dtype the device path uses
+    # (_as_device): unsigned -> uint64, bool/ints -> int64, floats as-is —
+    # narrow-dtype accumulation would wrap (uint8 sums mod 256)
+    if vals.dtype.kind == "u":
+        acc = np.uint64
+    elif vals.dtype.kind in "bi":
+        acc = np.int64
+    else:
+        acc = vals.dtype
+    v = np.where(valid, vals, np.zeros((), dtype=vals.dtype)).astype(
+        acc, copy=False
+    )
+    sums = np.zeros(num_segments, dtype=acc)
+    np.add.at(sums, gid, v)
+    counts = np.bincount(gid[valid], minlength=num_segments)
+    return sums, counts.astype(np.int64)
+
+
+def _host_minmax(gid, vals, valid, num_segments, mode):
+    if np.issubdtype(vals.dtype, np.floating):
+        isn = np.isnan(vals)
+        clean_mask = valid & ~isn
+        fill = np.inf if mode == "min" else -np.inf
+        clean = np.where(clean_mask, vals, fill)
+        out = np.full(num_segments, fill, dtype=vals.dtype)
+        (np.minimum if mode == "min" else np.maximum).at(out, gid, clean)
+        has_clean = np.bincount(gid[clean_mask], minlength=num_segments) > 0
+        if mode == "min":
+            # NaN wins only when the group has no non-NaN valid values
+            return np.where(has_clean, out, np.asarray(np.nan, vals.dtype))
+        has_nan = np.bincount(gid[valid & isn], minlength=num_segments) > 0
+        return np.where(has_nan, np.asarray(np.nan, vals.dtype), out)
+    fill = (
+        np.iinfo(vals.dtype).max if mode == "min" else np.iinfo(vals.dtype).min
+    ) if vals.dtype.kind in "iu" else (True if mode == "min" else False)
+    v = np.where(valid, vals, np.asarray(fill, dtype=vals.dtype))
+    out = np.full(num_segments, fill, dtype=vals.dtype)
+    (np.minimum if mode == "min" else np.maximum).at(out, gid, v)
+    return out
+
+
 def segment_sum_count(
     gid: np.ndarray,
     vals: np.ndarray,
@@ -88,6 +138,8 @@ def segment_sum_count(
     valid = (
         np.ones(len(vals), dtype=bool) if valid is None else valid
     )
+    if len(vals) <= _HOST_AGG_MAX_ROWS:
+        return _host_sum_count(gid, vals, valid, num_segments)
     s, c = _seg_sum_count(
         jnp.asarray(gid), _as_device(vals), jnp.asarray(valid), num_segments
     )
@@ -102,6 +154,8 @@ def segment_minmax(
     mode: str,
 ) -> np.ndarray:
     valid = np.ones(len(vals), dtype=bool) if valid is None else valid
+    if len(vals) <= _HOST_AGG_MAX_ROWS:
+        return _host_minmax(gid, vals, valid, num_segments, mode)
     fn = _seg_min if mode == "min" else _seg_max
     out = fn(jnp.asarray(gid), _as_device(vals), jnp.asarray(valid), num_segments)
     return np.asarray(out)
@@ -111,6 +165,10 @@ def segment_count(
     gid: np.ndarray, valid: Optional[np.ndarray], n: int, num_segments: int
 ) -> np.ndarray:
     valid = np.ones(n, dtype=bool) if valid is None else valid
+    if n <= _HOST_AGG_MAX_ROWS:
+        return np.bincount(
+            gid[valid], minlength=num_segments
+        ).astype(np.int64)
     counts = jax.ops.segment_sum(
         jnp.asarray(valid).astype(jnp.int64),
         jnp.asarray(gid),

@@ -24,6 +24,11 @@ import hyperspace_tpu.ops  # noqa: F401  (enables x64)
 
 _SIGN = np.uint32(0x80000000)
 
+# Below this row count lexsort runs as numpy on host (identical stable
+# semantics); the device sort pays transfer + readback that dwarfs the
+# sort itself for host-resident serve batches.
+_HOST_SORT_MAX_ROWS = 1 << 18
+
 
 def _order_words_np(key_reps: np.ndarray) -> np.ndarray:
     """[k, n] int64 -> [2k, n] uint32 planes whose lexicographic order
@@ -59,6 +64,10 @@ def lexsort_perm(planes: np.ndarray, n_valid: int | None = None) -> np.ndarray:
     if n == 0:
         return np.zeros(0, dtype=np.int64)
     planes = planes.astype(np.uint32, copy=False)
+    if planes.shape[1] <= _HOST_SORT_MAX_ROWS:
+        # host numpy lexsort: same stable semantics, no device round trip
+        # (host-resident serve batches pay transfer + readback otherwise)
+        return np.lexsort(planes[::-1])[:n]
     n_pad = pad_len(planes.shape[1])
     if n_pad != planes.shape[1]:
         fill = np.full(

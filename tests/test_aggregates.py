@@ -303,3 +303,50 @@ class TestSortLimit:
             df.group_by("g").agg(F.avg("s")).schema()
         with pytest.raises(HyperspaceException, match="sum"):
             df.group_by("g").agg(F.sum("s")).schema()
+
+
+def test_segment_ops_host_device_equivalent():
+    """The small-input host reductions and the device segment kernels must
+    agree (incl. int64 exactness, null handling and NaN min/max rules)."""
+    import numpy as np
+
+    from hyperspace_tpu.ops import aggregate as A
+
+    rng = np.random.default_rng(1)
+    n, g = 5000, 37
+    gid = rng.integers(0, g, n)
+    ints = rng.integers(-(2**40), 2**40, n, dtype=np.int64)
+    flts = rng.normal(size=n)
+    flts[rng.random(n) < 0.05] = np.nan
+    valid = rng.random(n) > 0.1
+
+    def both(fn, *args):
+        host = fn(*args)
+        old = A._HOST_AGG_MAX_ROWS
+        try:
+            A._HOST_AGG_MAX_ROWS = 0
+            dev = fn(*args)
+        finally:
+            A._HOST_AGG_MAX_ROWS = old
+        return host, dev
+
+    (hs, hc), (ds, dc) = both(A.segment_sum_count, gid, ints, valid, g)
+    assert np.array_equal(hs, ds) and np.array_equal(hc, dc)
+    for mode in ("min", "max"):
+        h, d = both(A.segment_minmax, gid, ints, valid, g, mode)
+        assert np.array_equal(h, d), mode
+        h, d = both(A.segment_minmax, gid, flts, valid, g, mode)
+        assert np.array_equal(h, d, equal_nan=True), mode
+    h, d = both(A.segment_count, gid, valid, n, g)
+    assert np.array_equal(h, d)
+
+
+def test_uint8_sum_does_not_wrap():
+    import numpy as np
+
+    from hyperspace_tpu.ops import aggregate as A
+
+    gid = np.zeros(2, dtype=np.int64)
+    vals = np.array([200, 200], dtype=np.uint8)
+    s, c = A.segment_sum_count(gid, vals, None, 1)
+    assert int(s[0]) == 400 and int(c[0]) == 2
