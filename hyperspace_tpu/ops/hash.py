@@ -101,6 +101,24 @@ def _bucket_ids_words(words, num_buckets: int, seed: int):
 _HOST_HASH_MAX_ROWS = 1 << 16
 
 
+def bucket_ids_host(
+    key_reps: np.ndarray, num_buckets: int, seed: int = 42
+) -> np.ndarray:
+    """Pure-numpy bucket ids — the bit-exact host twin of the device
+    kernel (same mix functions on np.uint32). Used for small inputs and
+    for host-side pre-passes that must never touch the device."""
+    n = key_reps.shape[1]
+    if n == 0:
+        return np.zeros(0, dtype=np.int32)
+    words = split_words_np(key_reps)
+    with np.errstate(over="ignore"):
+        h = np.full(n, np.uint32(seed))
+        for i in range(words.shape[0]):
+            h = _mix_h1(h, _mix_k1(words[i]))
+        h = _fmix(h, np.uint32(4 * words.shape[0]))
+    return (h % np.uint32(num_buckets)).astype(np.int32)
+
+
 def bucket_ids_np(key_reps: np.ndarray, num_buckets: int, seed: int = 42) -> np.ndarray:
     """Host entry: [k, n] int64 key reps -> int32 bucket ids. Large inputs
     hash on device (padded to a power of two, ops/__init__ shape policy);
@@ -108,14 +126,9 @@ def bucket_ids_np(key_reps: np.ndarray, num_buckets: int, seed: int = 42) -> np.
     n = key_reps.shape[1]
     if n == 0:
         return np.zeros(0, dtype=np.int32)
-    words = split_words_np(key_reps)
     if n <= _HOST_HASH_MAX_ROWS:
-        with np.errstate(over="ignore"):
-            h = np.full(n, np.uint32(seed))
-            for i in range(words.shape[0]):
-                h = _mix_h1(h, _mix_k1(words[i]))
-            h = _fmix(h, np.uint32(4 * words.shape[0]))
-        return (h % np.uint32(num_buckets)).astype(np.int32)
+        return bucket_ids_host(key_reps, num_buckets, seed)
+    words = split_words_np(key_reps)
     n_pad = pad_len(n)
     if n_pad != n:
         words = np.concatenate(

@@ -9,8 +9,11 @@ indexedCols)`` and the Hybrid-Scan on-the-fly shuffle,
 Each device hashes its local rows to buckets (``ops/hash.py``), routes rows
 to the device that owns the bucket (``bucket % D``), and exchanges them in
 ONE ``all_to_all`` over the ICI ring. Since XLA programs need static
-shapes, each device sends a fixed-capacity ``[D, n_local]`` buffer per peer
-plus a validity mask; the host compacts valid rows after the exchange.
+shapes, each device sends a ``[D, cap]`` buffer plus a validity mask, where
+``cap`` is the power-of-two-padded MAX per-(shard, peer) count computed on
+the host before dispatch — exchange memory tracks real traffic (~n_local
+for a balanced hash) instead of the worst-case ``D x n_local``; the host
+compacts valid rows after the exchange.
 (For >HBM datasets the same exchange runs once per wave over chunked host
 batches — the reference leans on Spark's disk-backed shuffle for this;
 our wave loop is ``indexes/covering_build._write_bucketed_streaming``,
@@ -38,11 +41,19 @@ except AttributeError:  # pragma: no cover
 
 
 @functools.partial(
-    jax.jit, static_argnames=("mesh", "num_buckets", "num_payload", "seed")
+    jax.jit, static_argnames=("mesh", "num_buckets", "num_payload", "seed", "cap")
 )
-def _shuffle_program(mesh, key_reps, valid, payloads, num_buckets, num_payload, seed):
+def _shuffle_program(
+    mesh, key_reps, valid, payloads, num_buckets, num_payload, seed, cap
+):
     """The compiled multi-chip shuffle. Shapes: key_reps [k, N], valid [N],
-    payloads tuple of [N]-arrays; N divisible by D = mesh size."""
+    payloads tuple of [N]-arrays; N divisible by D = mesh size.
+
+    ``cap`` is the per-(shard, peer) send capacity, computed on the host
+    from the actual destination counts and padded to a power of two. The
+    exchange buffer is [D, cap] per shard — sized to the real traffic —
+    instead of the worst-case [D, n_local] (which inflates memory D× and
+    was flagged as the first thing to OOM on a large mesh)."""
     del num_payload  # encoded in payloads pytree structure
     D = mesh.devices.size
 
@@ -51,17 +62,24 @@ def _shuffle_program(mesh, key_reps, valid, payloads, num_buckets, num_payload, 
         bucket = (hash_columns(reps, seed) % jnp.uint32(num_buckets)).astype(
             jnp.int32
         )
-        dest = bucket % D
+        # invalid (padding) rows route to sentinel destination D: they
+        # never occupy exchange slots, so cap tracks VALID traffic only
+        # (host counts valid rows only; see _exchange_cap)
+        dest = jnp.where(vld, bucket % D, jnp.int32(D))
         order = jnp.argsort(dest, stable=True)
         dest_s = dest[order]
-        counts = jnp.bincount(dest_s, length=D)
+        counts = jnp.bincount(dest_s, length=D + 1)
         offsets = jnp.concatenate(
             [jnp.zeros(1, dtype=counts.dtype), jnp.cumsum(counts)[:-1]]
         )
         rank = jnp.arange(n) - offsets[dest_s]
 
         def scatter(col, fill=0):
-            buf = jnp.full((D, n), fill, dtype=col.dtype)
+            buf = jnp.full((D, cap), fill, dtype=col.dtype)
+            # valid rows have dest_s < D and rank < cap (host-sized);
+            # sentinel-dest rows index row D and are dropped by .at[]'s
+            # out-of-bounds semantics. bucket_shuffle re-checks the
+            # compacted row count, so an undersized cap fails loudly.
             return buf.at[dest_s, rank].set(col[order])
 
         exchange = lambda x: lax.all_to_all(x, SHARD_AXIS, 0, 0, tiled=True)
@@ -119,6 +137,7 @@ def bucket_shuffle(
     valid = np.ones(n + pad, dtype=bool)
     if pad:
         valid[n:] = False
+    cap = _exchange_cap(key_reps, valid, num_buckets, D, seed)
     bucket, vmask, cols = _shuffle_program(
         mesh,
         jnp.asarray(key_reps),
@@ -127,8 +146,43 @@ def bucket_shuffle(
         num_buckets,
         len(payloads),
         seed,
+        cap,
     )
     bucket = np.asarray(bucket)
     vmask = np.asarray(vmask)
     keep = np.nonzero(vmask)[0]
+    if len(keep) != n:
+        raise RuntimeError(
+            f"bucket shuffle lost rows: sent {n}, received {len(keep)} "
+            f"(cap={cap}) — host/device hash divergence?"
+        )
     return bucket[keep], [np.asarray(c)[keep] for c in cols]
+
+
+def _exchange_cap(
+    key_reps: np.ndarray,
+    valid: np.ndarray,
+    num_buckets: int,
+    D: int,
+    seed: int,
+    chunk: int = 1 << 18,
+) -> int:
+    """Per-(shard, peer) exchange capacity: the power-of-two-padded MAX
+    count of VALID rows any shard sends to any peer. Host-only (chunked
+    numpy murmur3, bit-identical to the device hash — never dispatches
+    the unsharded array to one device) and pad rows are excluded (the
+    program routes them to a sentinel destination)."""
+    from hyperspace_tpu.ops import pad_len
+    from hyperspace_tpu.ops.hash import bucket_ids_host
+
+    total = key_reps.shape[1]
+    n_local = total // D
+    counts = np.zeros((D, D), dtype=np.int64)
+    for start in range(0, total, chunk):
+        end = min(start + chunk, total)
+        dest = bucket_ids_host(key_reps[:, start:end], num_buckets, seed) % D
+        shard = np.arange(start, end) // n_local
+        v = valid[start:end]
+        np.add.at(counts, (shard[v], dest[v]), 1)
+    max_count = max(int(counts.max()), 1)
+    return min(pad_len(max_count), n_local)  # never larger than a shard
