@@ -215,8 +215,6 @@ def main() -> None:
 
         # --- Delta incremental refresh (BASELINE config 5): index a Delta
         # table with lineage, commit appends, time the incremental refresh
-        import json as _json
-
         delta_dir = os.path.join(tmp, "delta_tbl")
         dlog = os.path.join(delta_dir, "_delta_log")
         os.makedirs(dlog)
@@ -240,7 +238,7 @@ def main() -> None:
                 "dataChange": True,
             }
 
-        schema_str = _json.dumps(
+        schema_str = json.dumps(
             {
                 "type": "struct",
                 "fields": [
@@ -250,9 +248,9 @@ def main() -> None:
             }
         )
         with open(os.path.join(dlog, f"{0:020d}.json"), "w") as f:
-            f.write(_json.dumps({"protocol": {"minReaderVersion": 1, "minWriterVersion": 2}}) + "\n")
+            f.write(json.dumps({"protocol": {"minReaderVersion": 1, "minWriterVersion": 2}}) + "\n")
             f.write(
-                _json.dumps(
+                json.dumps(
                     {
                         "metaData": {
                             "id": "bench",
@@ -264,16 +262,16 @@ def main() -> None:
                 )
                 + "\n"
             )
-            f.write(_json.dumps({"add": delta_file("part-0.parquet", n_delta)}) + "\n")
-        from hyperspace_tpu.indexes.covering import CoveringIndexConfig as CIC
+            f.write(json.dumps({"add": delta_file("part-0.parquet", n_delta)}) + "\n")
+        
 
         session.conf.set(C.INDEX_LINEAGE_ENABLED, True)
         ddf = session.read.delta(delta_dir)
-        hs.create_index(ddf, CIC("delta_idx", ["k"], ["q"]))
+        hs.create_index(ddf, CoveringIndexConfig("delta_idx", ["k"], ["q"]))
         n_append = max(n_delta // 8, 1)
         with open(os.path.join(dlog, f"{1:020d}.json"), "w") as f:
             f.write(
-                _json.dumps({"add": delta_file("part-1.parquet", n_append)}) + "\n"
+                json.dumps({"add": delta_file("part-1.parquet", n_append)}) + "\n"
             )
         session.index_manager.clear_cache()
         t0 = time.perf_counter()

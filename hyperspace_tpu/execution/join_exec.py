@@ -187,6 +187,13 @@ def co_bucketed_join(
     )
     sorted_l, sorted_r = rows_monotonic(l_pad), rows_monotonic(r_pad)
     if (sorted_l and sorted_r) or (single_device and not force_device):
+        # the pow2 bucket-width padding only serves the device kernel's
+        # compile cache; numpy has no static-shape constraint, so the
+        # host branch trims back to the real max bucket width
+        w_l = max(max(l_sizes) if l_sizes else 1, 1)
+        w_r = max(max(r_sizes) if r_sizes else 1, 1)
+        l_pad, l_rowmap = l_pad[:, :w_l], l_rowmap[:, :w_l]
+        r_pad, r_rowmap = r_pad[:, :w_r], r_rowmap[:, :w_r]
         # Not-sorted sides (hybrid tails, multi-key combines, multi-version
         # buckets) are stable-argsorted on HOST first: measured ~10x
         # cheaper than the device sort+transfer round trip on one chip.

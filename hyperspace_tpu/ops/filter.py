@@ -99,7 +99,7 @@ class _Prep:
                         self._arg(np.int64(hi)),
                     )
                 lit = E.lower_literal(
-                    right.value, self.batch.column(left.name).arrow_type
+                    right.value, self.batch.column(left.name).arrow_type, op
                 )
                 if lit is None:
                     # unrepresentable literal: constant truth value but

@@ -215,7 +215,7 @@ def conjunction(exprs: List[Expr]) -> Optional[Expr]:
     return out
 
 
-def lower_literal(value, arrow_type):
+def lower_literal(value, arrow_type, op: Optional[str] = None):
     """Engine-internal image of a literal for a column of ``arrow_type``.
 
     Temporal columns are stored as int64 epoch units (io/columnar ingest
@@ -257,20 +257,21 @@ def lower_literal(value, arrow_type):
         return None  # sub-ns units (ps/fs/as): beyond engine precision
     v_ns = int(dt64.view("int64")) * ns_per[src_unit]
     q, r = divmod(v_ns, ns_per[unit])
+    if r != 0:
+        # literal falls BETWEEN column ticks q and q+1 (divmod floors).
+        # With the comparison operator known, the boundary shifts to an
+        # EXACT integer: col < lit ⟺ col <= q ⟺ col < q+1, and
+        # col >= lit ⟺ col >= q+1; col <= lit ⟺ col <= q, col > lit ⟺
+        # col > q. Equality can never hold (op None / = / != return None;
+        # callers treat that as never-true, != as true-for-valid).
+        if op in ("<", ">="):
+            q = q + 1
+        elif op not in ("<=", ">"):
+            return None
     if q > np.iinfo(np.int64).max:
         return np.float64("inf")
     if q < np.iinfo(np.int64).min:
         return np.float64("-inf")
-    if r != 0:
-        # literal falls BETWEEN two column ticks: q + 0.5 gives every
-        # comparison its exact answer (col <= q ⟺ col < lit; equality is
-        # False since no int equals x.5). Exact while q < 2^53 — true for
-        # every unit coarser than ns, which is the only way r != 0 arises
-        # (ns literals against a coarser column); beyond float precision
-        # fall back to unrepresentable.
-        if abs(q) >= (1 << 53):
-            return None
-        return np.float64(q) + 0.5
     return np.int64(q)
 
 
@@ -353,7 +354,14 @@ def lower_in_literals(values, arrow_type) -> List[Any]:
             if lv is not None and isinstance(lv, np.int64):
                 out.append(lv)
         return out
-    return [v for v in values if isinstance(v, (int, float, bool))]
+    out = []
+    for v in values:
+        # numpy scalars are first-class literals (df['k'].isin(arr[0]))
+        if isinstance(v, (np.integer, np.floating, np.bool_)):
+            v = v.item()
+        if isinstance(v, (int, float, bool)):
+            out.append(v)
+    return out
 
 
 def normalize_comparison(expr: Expr) -> Optional[Tuple[str, str, Any]]:
@@ -476,7 +484,7 @@ def _cmp(expr: Expr, batch, op_name: str) -> Tuple[np.ndarray, Optional[np.ndarr
             r = vref.rank_values()
             vals = {"<": r < lo, "<=": r < hi, ">": r >= hi, ">=": r >= lo}[op_name]
             return vals, vref.valid
-        lit = lower_literal(lit, batch.column(left.name).arrow_type)
+        lit = lower_literal(lit, batch.column(left.name).arrow_type, op_name)
         if lit is None:
             # literal unrepresentable in the column's type: equality and
             # orderings can never hold; != holds for every non-null row

@@ -149,7 +149,7 @@ def explain_string(
     ]
     for name in sorted(used):
         ver, root = used[name]
-        buf.append(f"{name} (v{ver}): {root}")
+        buf.append(dm.escape(f"{name} (v{ver}): {root}"))
     if not used:
         buf.append("(none)")
     buf.append("")
@@ -159,7 +159,7 @@ def explain_string(
             _BAR,
             "Operator diff:",
             _BAR,
-            _operator_diff_table(optimized, original),
+            dm.escape(_operator_diff_table(optimized, original)),
             "",
             _BAR,
             "Applicable indexes:",
@@ -169,8 +169,10 @@ def explain_string(
         for e in sorted(active, key=lambda e: e.name):
             index = e.derived_dataset
             buf.append(
-                f"{e.name}: kind={index.kind}, "
-                f"indexed={list(index.indexed_columns)}"
+                dm.escape(
+                    f"{e.name}: kind={index.kind}, "
+                    f"indexed={list(index.indexed_columns)}"
+                )
             )
         if not active:
             buf.append("(none)")

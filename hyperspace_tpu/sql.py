@@ -145,8 +145,18 @@ class _Parser:
 
         if where is not None:
             df = df.filter(where)
+        # standard SQL allows ORDER BY on columns outside the select list
+        # (for non-aggregate queries): sort before projecting in that case
+        sorted_early = False
+        if order and group_by is None and not any(
+            it[0] == "agg" for it in items
+        ) and items != [("star",)]:
+            selected = {it[1].lower() for it in items if it[0] == "col"}
+            if any(c.lower() not in selected for c, _ in order):
+                df = df.sort(*order)
+                sorted_early = True
         df = self._apply_select(df, items, group_by)
-        if order:
+        if order and not sorted_early:
             df = df.sort(*order)
         if limit is not None:
             df = df.limit(limit)

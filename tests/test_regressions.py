@@ -354,6 +354,35 @@ class TestTemporalLiterals:
         # false positives)
         assert df.filter(df["ts"].isin(mid)).collect().num_rows == 0
 
+    def test_numpy_scalar_in_list(self, session, tmp_path):
+        """isin(np.int64(5)) must behave like == np.int64(5)."""
+        d = tmp_path / "npscalar"
+        d.mkdir()
+        pq.write_table(
+            pa.table({"k": pa.array([3, 5, 7], type=pa.int64())}),
+            d / "a.parquet",
+        )
+        df = session.read.parquet(str(d))
+        lit = np.int64(5)
+        assert df.filter(df["k"].isin(lit)).collect().num_rows == 1
+        assert df.filter(df["k"] == lit).collect().num_rows == 1
+
+    def test_between_tick_ordering_far_future(self, session, tmp_path):
+        """Between-tick literals keep exact ordering even beyond float53
+        epochs (op-aware integer boundary, no float rounding)."""
+        d = tmp_path / "far"
+        d.mkdir()
+        ts = np.array(
+            ["2260-01-01T00:00:00", "2262-01-01T00:00:00"],
+            dtype="datetime64[us]",
+        )
+        pq.write_table(pa.table({"ts": pa.array(ts)}), d / "a.parquet")
+        df = session.read.parquet(str(d))
+        mid = np.datetime64("2261-01-01T00:00:00.000000500", "ns")
+        assert df.filter(df["ts"] < mid).collect().num_rows == 1
+        assert df.filter(df["ts"] >= mid).collect().num_rows == 1
+        assert df.filter(df["ts"] == mid).collect().num_rows == 0
+
     def test_not_unrepresentable_excludes_nulls_both_paths(
         self, session, tmp_path
     ):

@@ -103,6 +103,17 @@ class TestSqlBasics:
         assert out.column_names == ["tag", "t"]
         assert out.num_rows == 3
 
+    def test_order_by_unselected_column(self, session, views):
+        out = session.sql(
+            "SELECT k FROM items ORDER BY qty DESC LIMIT 5"
+        ).collect()
+        assert out.column_names == ["k"] and out.num_rows == 5
+        items, _ = views
+        want = (
+            items.sort(("qty", False)).limit(5).select("k").collect()
+        )
+        assert out.column("k").to_pylist() == want.column("k").to_pylist()
+
     def test_negative_literal(self, session, views):
         out = session.sql("SELECT k FROM items WHERE k > -1").collect()
         assert out.num_rows == 400
