@@ -263,7 +263,7 @@ def main() -> None:
                 + "\n"
             )
             f.write(json.dumps({"add": delta_file("part-0.parquet", n_delta)}) + "\n")
-        
+
 
         session.conf.set(C.INDEX_LINEAGE_ENABLED, True)
         ddf = session.read.delta(delta_dir)

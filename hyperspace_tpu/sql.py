@@ -19,9 +19,11 @@ Supported grammar (the subset the reference's examples/docs exercise):
       [LIMIT n]
 
     item := col | SUM|MIN|MAX|AVG|COUNT ( col | * ) [AS alias]
-    expr := comparisons (= != <> < <= > >=), IN (...), IS [NOT] NULL,
-            AND / OR / NOT, parentheses; literals: numbers, 'strings',
-            TRUE/FALSE/NULL, DATE 'YYYY-MM-DD'
+    expr := comparisons (= != <> < <= > >=), [NOT] IN (...),
+            [NOT] BETWEEN a AND b, IS [NOT] NULL, AND / OR / NOT,
+            parentheses; literals: numbers (incl. negative), 'strings',
+            TRUE/FALSE/NULL, DATE 'YYYY-MM-DD'. ORDER BY may reference
+            columns outside the select list (non-aggregate queries).
 """
 
 from __future__ import annotations
@@ -329,11 +331,20 @@ class _Parser:
             self.expect_keyword("null")
             e: E.Expr = E.IsNull(E.Col(name))
             return E.Not(e) if negate else e
-        if self.at_keyword("in") or self.at_keyword("not"):
+        if self.at_keyword("in", "not", "between"):
             negate = False
             if self.at_keyword("not"):
                 self.next()
                 negate = True
+            if self.at_keyword("between"):
+                self.next()
+                lo = self._literal()
+                self.expect_keyword("and")
+                hi = self._literal()
+                e: E.Expr = E.And(
+                    E.Ge(E.Col(name), E.Lit(lo)), E.Le(E.Col(name), E.Lit(hi))
+                )
+                return E.Not(e) if negate else e
             self.expect_keyword("in")
             self.expect_op("(")
             vals = [self._literal()]

@@ -103,6 +103,21 @@ class TestSqlBasics:
         assert out.column_names == ["tag", "t"]
         assert out.num_rows == 3
 
+    def test_between(self, session, views):
+        out = session.sql(
+            "SELECT k, qty FROM items WHERE qty BETWEEN 3 AND 5"
+        ).collect()
+        assert out.num_rows > 0
+        assert all(3 <= q <= 5 for q in out.column("qty").to_pylist())
+        out2 = session.sql(
+            "SELECT k FROM items WHERE qty NOT BETWEEN 3 AND 5 AND k = 1"
+        ).collect()
+        items, _ = views
+        want = items.filter(
+            ~((items["qty"] >= 3) & (items["qty"] <= 5)) & (items["k"] == 1)
+        ).collect()
+        assert out2.num_rows == want.num_rows
+
     def test_order_by_unselected_column(self, session, views):
         out = session.sql(
             "SELECT k FROM items ORDER BY qty DESC LIMIT 5"
