@@ -116,6 +116,31 @@ class TestDisplayModes:
             assert norm == f.read()
 
 
+class TestWhyNotGolden:
+    def test_why_not_golden(self, session, hs, df, sample_parquet):
+        """Golden-file protection for the why_not report format (the
+        reference pins whyNot_* fixtures per version)."""
+        import os
+        import re
+
+        hs.create_index(df, CoveringIndexConfig("wn_idx", ["clicks"], ["query"]))
+        session.enable_hyperspace()
+        # predicate on a non-first-indexed column: index NOT applicable
+        q = df.filter(df["query"] == "banana").select("query", "imprs")
+        out = hs.why_not(q)
+        norm = out.replace(sample_parquet, "<src>")
+        norm = re.sub(r"LogVersion: \d+", "LogVersion: N", norm)
+        golden = os.path.join(
+            os.path.dirname(__file__), "goldstandard", "why_not_filter.txt"
+        )
+        if os.environ.get("HS_GENERATE_GOLDEN_FILES") == "1":
+            with open(golden, "w") as f:
+                f.write(norm)
+            pytest.skip("golden regenerated")
+        with open(golden) as f:
+            assert norm == f.read()
+
+
 class TestProfilerIntegration:
     def test_trace_dir_produces_trace(self, session, df, tmp_path):
         trace_dir = str(tmp_path / "trace")
