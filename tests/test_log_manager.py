@@ -92,3 +92,32 @@ def test_write_log_does_not_stamp_id_on_conflict(tmp_path):
     loser.id = 99
     assert mgr.write_log(0, loser) is False
     assert loser.id == 99  # untouched on conflict
+
+
+def test_concurrent_writers_exactly_one_wins(tmp_path):
+    """OCC under REAL concurrency: N threads race to write the same log id;
+    exactly one atomic create-if-absent succeeds and the surviving content
+    is exactly the winner's (reference: concurrent writeLog failure paths,
+    IndexLogManagerImplTest)."""
+    import threading
+
+    path = str(tmp_path / "idx")
+    results = {}
+    barrier = threading.Barrier(8)
+
+    def writer(i):
+        mgr = IndexLogManager(path)
+        entry = make_entry(state=States.CREATING)
+        entry.name = f"writer-{i}"
+        barrier.wait()
+        results[i] = mgr.write_log(1, entry)
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    winners = [i for i, ok in results.items() if ok]
+    assert len(winners) == 1, results
+    stored = IndexLogManager(path).get_log(1)
+    assert stored.name == f"writer-{winners[0]}"
