@@ -103,3 +103,65 @@ def test_data_manager_failure_does_not_corrupt_log(session, src, monkeypatch):
     assert (
         session.index_manager.get_index_log_entry("didx").state == States.ACTIVE
     )
+
+
+class FailAfterNWritesLogManager(IndexLogManager):
+    """Crashes on the Nth write_log call across all instances."""
+
+    fail_at = 2
+    _count = 0
+
+    def write_log(self, log_id, entry):
+        type(self)._count += 1
+        if type(self)._count == self.fail_at:
+            raise OSError("injected: crash mid-refresh")
+        return super().write_log(log_id, entry)
+
+
+def test_crash_during_refresh_recovers_to_previous_version(
+    session, src, monkeypatch
+):
+    """A refresh that crashes at end() leaves REFRESHING; cancel() rolls
+    back to the previous ACTIVE version and the index still serves."""
+    import pyarrow.parquet as _pq
+
+    hs = Hyperspace(session)
+    df = session.read.parquet(src)
+    hs.create_index(df, CoveringIndexConfig("ridx", ["k"], ["v"]))
+    # appended file so refresh has work to do
+    rng2 = np.random.default_rng(1)
+    _pq.write_table(
+        pa.table(
+            {
+                "k": pa.array(rng2.integers(0, 20, 30), type=pa.int64()),
+                "v": pa.array(rng2.normal(size=30)),
+            }
+        ),
+        src + "/b.parquet",
+    )
+    session.index_manager.clear_cache()
+    FailAfterNWritesLogManager._count = 0
+    monkeypatch.setattr(
+        factories, "log_manager_factory", FailAfterNWritesLogManager
+    )
+    with pytest.raises(OSError, match="injected"):
+        hs.refresh_index("ridx", C.REFRESH_MODE_FULL)
+    monkeypatch.setattr(factories, "log_manager_factory", IndexLogManager)
+    session.index_manager.clear_cache()
+    assert (
+        session.index_manager._managers("ridx")[0].get_latest_log().state
+        == States.REFRESHING
+    )
+    hs.cancel("ridx")
+    session.index_manager.clear_cache()
+    entry = session.index_manager.get_index_log_entry("ridx")
+    assert entry.state == States.ACTIVE
+    # the rolled-back index still serves the ORIGINAL data correctly
+    session.enable_hyperspace()
+    df0 = session.read.parquet(src + "/a.parquet")
+    q = df0.filter(df0["k"] == 3).select("k", "v")
+    got = q.collect()
+    session.disable_hyperspace()
+    base = q.collect()
+    key = lambda t: t.sort_by([(c, "ascending") for c in t.column_names])
+    assert key(got).equals(key(base))
