@@ -91,13 +91,24 @@ class Column:
             # int column): decode and treat as a plain fixed-width column.
             arr = arr.cast(t.value_type)
             t = arr.type
+        if pa.types.is_time(t):
+            # time32/time64 decode to python datetime.time objects via
+            # to_numpy; go through the integer representation instead
+            # (``to_arrow`` restores the logical type). ``t`` stays the
+            # logical arrow_type.
+            arr = arr.cast(
+                pa.int32() if pa.types.is_time32(t) else pa.int64()
+            )
         validity = None
         if arr.null_count:
             validity = np.asarray(arr.is_valid())
             # Fill nulls with a typed zero so to_numpy keeps the natural
             # dtype (nullable ints would otherwise decode as float64 and
-            # break the cross-file key-rep stability contract).
-            fill = pa.scalar(False if pa.types.is_boolean(t) else 0, type=t)
+            # break the cross-file key-rep stability contract). Typed by
+            # arr.type, not t: time columns were just cast to ints above.
+            fill = pa.scalar(
+                False if pa.types.is_boolean(arr.type) else 0, type=arr.type
+            )
             arr = arr.fill_null(fill)
         vals = arr.to_numpy(zero_copy_only=False)
         if vals.dtype == object:

@@ -232,7 +232,9 @@ def lower_literal(value, arrow_type, op: Optional[str] = None):
         return value
     unit = _temporal_storage_unit(arrow_type)
     if unit is None:
-        return value  # time/duration types: untouched (pre-existing path)
+        if pa.types.is_time(arrow_type):
+            return _lower_time_literal(value, arrow_type, op)
+        return value  # duration types: untouched (pre-existing path)
     dt64 = _as_datetime64(value)
     if dt64 is None:
         return None
@@ -256,23 +258,56 @@ def lower_literal(value, arrow_type, op: Optional[str] = None):
     if src_unit not in ns_per:
         return None  # sub-ns units (ps/fs/as): beyond engine precision
     v_ns = int(dt64.view("int64")) * ns_per[src_unit]
-    q, r = divmod(v_ns, ns_per[unit])
-    if r != 0:
-        # literal falls BETWEEN column ticks q and q+1 (divmod floors).
-        # With the comparison operator known, the boundary shifts to an
-        # EXACT integer: col < lit ⟺ col <= q ⟺ col < q+1, and
-        # col >= lit ⟺ col >= q+1; col <= lit ⟺ col <= q, col > lit ⟺
-        # col > q. Equality can never hold (op None / = / != return None;
-        # callers treat that as never-true, != as true-for-valid).
-        if op in ("<", ">="):
-            q = q + 1
-        elif op not in ("<=", ">"):
-            return None
+    q = _snap_between_tick(*divmod(v_ns, ns_per[unit]), op)
+    if q is None:
+        return None
     if q > np.iinfo(np.int64).max:
         return np.float64("inf")
     if q < np.iinfo(np.int64).min:
         return np.float64("-inf")
     return np.int64(q)
+
+
+def _snap_between_tick(q, r, op):
+    """Boundary snap for a literal BETWEEN column ticks q and q+1 (divmod
+    floors): col < lit ⟺ col <= q ⟺ col < q+1 and col >= lit ⟺
+    col >= q+1; col <= lit ⟺ col <= q, col > lit ⟺ col > q. Equality
+    can never hold — op None / = / != return None (callers treat that as
+    never-true, != as true-for-valid). Shared by the timestamp/date and
+    time-of-day lowering paths so their semantics can't diverge."""
+    if r == 0:
+        return q
+    if op in ("<", ">="):
+        return q + 1
+    if op in ("<=", ">"):
+        return q
+    return None
+
+
+def _lower_time_literal(value, arrow_type, op):
+    """datetime.time / ISO string -> int64 in the time column's unit
+    (time-of-day columns ingest as their integer representation)."""
+    import datetime as _dt
+
+    if isinstance(value, str):
+        try:
+            value = _dt.time.fromisoformat(value)
+        except ValueError:
+            return None
+    if not isinstance(value, _dt.time):
+        return None
+    if value.tzinfo is not None:
+        # a zoned time-of-day cannot be compared to naive column values
+        # (the timestamp path CONVERTS offsets; here there is no date to
+        # anchor the conversion) — unrepresentable, never matches
+        return None
+    ns = (
+        ((value.hour * 60 + value.minute) * 60 + value.second) * 10**9
+        + value.microsecond * 1000
+    )
+    per = {"s": 10**9, "ms": 10**6, "us": 10**3, "ns": 1}[arrow_type.unit]
+    q = _snap_between_tick(*divmod(ns, per), op)
+    return None if q is None else np.int64(q)
 
 
 def _temporal_storage_unit(arrow_type):

@@ -84,6 +84,10 @@ def _stat_to_float(v) -> float:
         return float(np.datetime64(v, "us").view("int64"))
     if isinstance(v, _dt.date):
         return float(np.datetime64(v, "D").view("int64"))
+    if isinstance(v, _dt.time):
+        return float(
+            ((v.hour * 60 + v.minute) * 60 + v.second) * 10**6 + v.microsecond
+        )
     return _norm(v)
 
 

@@ -354,6 +354,49 @@ class TestTemporalLiterals:
         # false positives)
         assert df.filter(df["ts"].isin(mid)).collect().num_rows == 0
 
+    def test_time_columns_roundtrip_and_filter(self, session, tmp_path):
+        """time32/time64 columns ingest (as their integer representation),
+        round-trip, and compare against datetime.time / ISO literals."""
+        import datetime
+
+        d = tmp_path / "times"
+        d.mkdir()
+        t64 = pa.array(
+            [datetime.time(9, 0), datetime.time(12, 30), datetime.time(18, 45)],
+            type=pa.time64("us"),
+        )
+        t32 = pa.array(
+            [datetime.time(1, 0), None, datetime.time(23, 59)],
+            type=pa.time32("s"),
+        )
+        pq.write_table(
+            pa.table({"a": t64, "b": t32, "v": pa.array([1, 2, 3], pa.int64())}),
+            d / "x.parquet",
+        )
+        df = session.read.parquet(str(d))
+        out = df.collect()
+        assert out.column("a").to_pylist() == t64.to_pylist()
+        assert out.column("b").to_pylist() == t32.to_pylist()
+        assert df.filter(df["a"] == datetime.time(12, 30)).collect().num_rows == 1
+        assert df.filter(df["a"] > datetime.time(10, 0)).collect().num_rows == 2
+        assert df.filter(df["a"] <= "12:30:00").collect().num_rows == 2
+        assert df.filter(df["b"] < datetime.time(2, 0)).collect().num_rows == 1
+        # between-tick on a seconds column: 01:00:00.5 lies between ticks
+        assert df.filter(
+            df["b"] <= datetime.time(1, 0, 0, 500000)
+        ).collect().num_rows == 1
+        assert df.filter(
+            df["b"] == datetime.time(1, 0, 0, 500000)
+        ).collect().num_rows == 0
+        # zoned time-of-day has no date to anchor a conversion: never matches
+        zoned = datetime.time(12, 30, tzinfo=datetime.timezone.utc)
+        assert df.filter(df["a"] == zoned).collect().num_rows == 0
+        # layout analysis handles time columns (footer stats are time objs)
+        from hyperspace_tpu.plananalysis.minmax_analysis import analyze_min_max
+
+        res = analyze_min_max(df, ["a", "b"])
+        assert all(r.max_files_per_lookup == 1 for r in res)
+
     def test_numpy_scalar_in_list(self, session, tmp_path):
         """isin(np.int64(5)) must behave like == np.int64(5)."""
         d = tmp_path / "npscalar"
