@@ -79,9 +79,9 @@ def _scan_with_lineage(
 def materialize_if_scan(data) -> ColumnarBatch:
     """ColumnarBatch passthrough; a lazy :class:`SourceScan` is read whole.
 
-    For consumers that need the full dataset in memory regardless of the
-    build memory budget — today the z-order build, whose global min/max
-    normalization and total sort are not yet streamed."""
+    For consumers that need the data in memory regardless of the build
+    memory budget — today only the z-order INCREMENTAL refresh delta
+    (small by construction; create/full-refresh z-order builds stream)."""
     return data.materialize() if isinstance(data, SourceScan) else data
 
 

@@ -121,8 +121,8 @@ class ZOrderCoveringIndex(Index):
             _idx, data = covering_build.create_covering_index(
                 ctx, appended_df, self._config(), dict(self.properties)
             )
-            # z-order needs the whole delta in memory (global min/max +
-            # total z-sort); the streaming wave loop is covering-index only
+            # incremental refresh z-sorts the delta on its own and the
+            # delta is small by construction; the full/create paths stream
             parts.append(
                 covering_build.materialize_if_scan(data).select(schema_cols)
             )
@@ -155,7 +155,7 @@ class ZOrderCoveringIndex(Index):
         new_index, batch = covering_build.create_covering_index(
             ctx, df, self._config(), dict(self.properties)
         )
-        batch = covering_build.materialize_if_scan(batch)
+        # a SourceScan flows straight into write (streamed two-pass build)
         # create_covering_index builds a CoveringIndex; re-wrap with our kind
         rebuilt = ZOrderCoveringIndex(
             new_index.indexed_columns,
@@ -182,15 +182,22 @@ class ZOrderCoveringIndex(Index):
 
 
 def _write_zordered(
-    ctx, batch: ColumnarBatch, indexed_cols: List[str], target_bytes: int
+    ctx, data, indexed_cols: List[str], target_bytes: int
 ) -> List[str]:
     """Global z-sort then split into ~equal files sized to hit the target
-    partition bytes."""
+    partition bytes. ``data`` is a ColumnarBatch or (for datasets beyond
+    the build memory budget) a lazy SourceScan streamed in two passes."""
     import os
 
+    from hyperspace_tpu.indexes.covering_build import SourceScan
     from hyperspace_tpu.ops.zorder import z_order_permutation
 
     os.makedirs(ctx.index_data_path, exist_ok=True)
+    if isinstance(data, SourceScan):
+        return _write_zordered_streaming(
+            ctx, data, indexed_cols, target_bytes
+        )
+    batch = data
     if batch.num_rows == 0:
         return []
     conf = ctx.session.conf
@@ -212,6 +219,208 @@ def _write_zordered(
         pio.write_table(path, chunk)
         written.append(path)
     return written
+
+
+# range-partition count for the streamed z-order spill: top bits of the
+# most-significant z-address plane (64 contiguous z-ranges; peak merge
+# memory ~= total/64 for a balanced address space)
+_ZORDER_SPILL_BITS = 6
+
+
+def _write_zordered_streaming(
+    ctx, scan, indexed_cols: List[str], target_bytes: int
+) -> List[str]:
+    """The >memory-budget z-order build (two passes over the waves):
+
+    1. **Stats pass** (indexed columns only): accumulate each column's
+       order-encodings — global min/max, plus a bounded stride sample
+       when quantile encoding is on — and FREEZE the encoding spec
+       (``ZOrderEncoder``). A fixed spec makes z-addresses identical in
+       every later step, so local order == global order.
+    2. **Spill pass**: per wave, compute z-address planes under the
+       frozen spec and spill rows into 2^_ZORDER_SPILL_BITS contiguous
+       z-RANGES (top bits of the most significant plane) — the streamed
+       equivalent of the reference's ``repartitionByRange`` on ``_zaddr``
+       (ZOrderCoveringIndex.scala:139-153).
+    3. **Merge**: per range in ascending order, re-encode + lexsort (a
+       range holds ~1/64 of the data) and write size-targeted files.
+    """
+    import os
+    import shutil
+
+    from hyperspace_tpu.indexes.covering_build import plan_waves
+    from hyperspace_tpu.io.columnar import ColumnarBatch
+    from hyperspace_tpu.ops.sort import lexsort_perm
+    from hyperspace_tpu.ops.zorder import ZOrderEncoder, order_u64_np
+
+    conf = ctx.session.conf
+    budget = conf.build_memory_budget or (1 << 62)
+    quantile = conf.zorder_quantile_enabled
+    rel_err = conf.zorder_quantile_relative_error
+    waves = plan_waves(scan.files, scan.fmt, budget, scan.file_sizes)
+
+    # pass 1: frozen encoding spec from a stats-only scan
+    import dataclasses
+
+    stats_scan = dataclasses.replace(
+        scan, columns=tuple(indexed_cols), file_ids=None, select_cols=None
+    )
+    k = len(indexed_cols)
+    mins = [None] * k
+    maxs = [None] * k
+    samples: List[List] = [[] for _ in range(k)]
+    dicts: List = [None] * k  # string columns: global dictionary union
+    max_sample = max(int(1.0 / max(rel_err, 1e-4) ** 2), 1024)
+    per_wave = max(max_sample // max(len(waves), 1), 64)
+    for w in waves:
+        b = stats_scan.materialize(w)
+        for j, c in enumerate(indexed_cols):
+            col = b.column(c)
+            if col.kind == "string":
+                # batch-local dictionary ranks are NOT stable across
+                # waves; freeze a GLOBAL dictionary instead
+                if dicts[j] is None:
+                    dicts[j] = set()
+                dicts[j].update(col.dictionary)
+                continue
+            e = order_u64_np(col)
+            if not len(e):
+                continue
+            lo, hi = e.min(), e.max()
+            mins[j] = lo if mins[j] is None else min(mins[j], lo)
+            maxs[j] = hi if maxs[j] is None else max(maxs[j], hi)
+            if quantile:
+                samples[j].append(e[:: max(1, len(e) // per_wave)])
+    specs = []
+    for j in range(k):
+        if dicts[j] is not None:
+            specs.append(("dict", sorted(dicts[j])))
+        elif quantile:
+            s = (
+                np.sort(np.concatenate(samples[j]))
+                if samples[j]
+                else np.zeros(1, dtype=np.uint64)
+            )
+            specs.append(("quantile", s))
+        else:
+            specs.append(
+                (
+                    "range",
+                    mins[j] if mins[j] is not None else np.uint64(0),
+                    maxs[j] if maxs[j] is not None else np.uint64(0),
+                )
+            )
+    encoder = ZOrderEncoder(16, specs)
+
+    # pass 2: spill into contiguous z-ranges
+    spill_root = os.path.join(
+        os.path.dirname(ctx.index_data_path),
+        "_spill_z_" + os.path.basename(ctx.index_data_path).replace("=", "_"),
+    )
+    os.makedirs(spill_root, exist_ok=True)
+    range_parts: dict = {}
+    try:
+        import pyarrow as pa
+
+        for wi, w in enumerate(waves):
+            batch = scan.materialize(w)
+            if batch.num_rows == 0:
+                continue
+            planes = encoder.planes(
+                [batch.column(c) for c in indexed_cols]
+            )
+            pid = (planes[0] >> np.uint32(32 - _ZORDER_SPILL_BITS)).astype(
+                np.int32
+            )
+            table = batch.to_arrow()
+            for p, idx in pio.bucket_runs(pid):
+                path = os.path.join(spill_root, f"r{p:03d}-w{wi:05d}.parquet")
+                pio.write_table(path, table.take(pa.array(idx)))
+                range_parts.setdefault(p, []).append(path)
+
+        # merge: per z-range ascending, local sort == global order.
+        # A skewed/constant key can funnel most rows into ONE range;
+        # oversized ranges split recursively on deeper z-address bits,
+        # and when the bits are exhausted (all rows share one z-address,
+        # whose relative order is semantically arbitrary) each part is
+        # sorted and written individually — peak memory stays bounded.
+        from hyperspace_tpu.indexes.covering_build import (
+            estimated_materialized_bytes,
+        )
+
+        written: List[str] = []
+        state = {"file_idx": 0}
+
+        def write_sorted(table):
+            nbytes = max(table.nbytes, 1)
+            num_parts = max(1, math.ceil(nbytes / target_bytes))
+            rows_per_part = math.ceil(table.num_rows / num_parts)
+            for i in range(num_parts):
+                chunk = table.slice(i * rows_per_part, rows_per_part)
+                if chunk.num_rows == 0:
+                    continue
+                path = os.path.join(
+                    ctx.index_data_path,
+                    f"part-{state['file_idx']:05d}-zorder.parquet",
+                )
+                pio.write_table(path, chunk)
+                written.append(path)
+                state["file_idx"] += 1
+
+        def sort_batch(batch):
+            perm = lexsort_perm(
+                encoder.planes([batch.column(c) for c in indexed_cols])
+            )
+            return batch.take(perm).to_arrow()
+
+        def merge_parts(parts, shift):
+            est = estimated_materialized_bytes(parts, "parquet")
+            if est <= budget or shift < 0:
+                if shift < 0 and est > budget:
+                    # single z-address dominates: order among equal
+                    # addresses is arbitrary — sort parts independently
+                    for part in parts:
+                        write_sorted(
+                            sort_batch(
+                                ColumnarBatch.from_arrow(
+                                    pio.read_table([part], None)
+                                )
+                            )
+                        )
+                    return
+                write_sorted(
+                    sort_batch(
+                        ColumnarBatch.from_arrow(pio.read_table(parts, None))
+                    )
+                )
+                return
+            # split on the next _ZORDER_SPILL_BITS bits of plane 0
+            sub_parts: dict = {}
+            next_shift = shift - _ZORDER_SPILL_BITS
+            for part in parts:
+                b = ColumnarBatch.from_arrow(pio.read_table([part], None))
+                planes0 = encoder.planes(
+                    [b.column(c) for c in indexed_cols]
+                )[0]
+                sub = ((planes0 >> np.uint32(max(shift, 0)))
+                       & np.uint32((1 << _ZORDER_SPILL_BITS) - 1)).astype(
+                    np.int32
+                )
+                table = b.to_arrow()
+                for sp, idx in pio.bucket_runs(sub):
+                    path = part + f".s{sp:03d}"
+                    pio.write_table(path, table.take(pa.array(idx)))
+                    sub_parts.setdefault(sp, []).append(path)
+            for sp in sorted(sub_parts):
+                merge_parts(sub_parts[sp], next_shift)
+
+        for p in sorted(range_parts):
+            merge_parts(
+                range_parts[p], 32 - 2 * _ZORDER_SPILL_BITS
+            )
+        return written
+    finally:
+        shutil.rmtree(spill_root, ignore_errors=True)
 
 
 class ZOrderCoveringIndexConfig(IndexConfigTrait):
@@ -257,9 +466,8 @@ class ZOrderCoveringIndexConfig(IndexConfigTrait):
         covering, batch = covering_build.create_covering_index(
             ctx, source_data, self, properties
         )
-        # z-order's global normalization + total sort are not streamed;
-        # materialize even when the covering build would have waved it
-        batch = covering_build.materialize_if_scan(batch)
+        # a SourceScan (dataset beyond the memory budget) flows straight
+        # into write(): the streamed two-pass z-order build handles it
         index = ZOrderCoveringIndex(
             covering.indexed_columns,
             covering.included_columns,

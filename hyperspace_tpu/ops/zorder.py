@@ -57,19 +57,6 @@ def order_u64_np(col) -> np.ndarray:
 
 
 @functools.partial(jax.jit, static_argnames=("bits",))
-def _normalize(enc_hi, enc_lo, mins_hi, mins_lo, ranges_f, bits: int):
-    """Scale (hi,lo) 32-bit planes of order-encodings onto [0, 2^bits)."""
-    # relative offset as float64 (exact enough: bits<=21 keeps us inside
-    # the 52-bit mantissa)
-    off = (enc_hi - mins_hi).astype(jnp.float64) * (2.0**32) + (
-        enc_lo.astype(jnp.float64) - mins_lo.astype(jnp.float64)
-    )
-    scale = jnp.where(ranges_f > 0, ((2.0**bits) - 1) / ranges_f, 0.0)
-    w = jnp.clip(off * scale, 0, (2.0**bits) - 1)
-    return w.astype(jnp.uint32)
-
-
-@functools.partial(jax.jit, static_argnames=("bits",))
 def _interleave(words, bits: int):
     """[k, n] uint32 (each < 2^bits) -> [ceil(k*bits/32), n] uint32 planes,
     most-significant plane first; lexsort over planes == z-order."""
@@ -88,24 +75,127 @@ def _interleave(words, bits: int):
     return planes
 
 
-def _quantile_words_np(
-    enc: np.ndarray, bits: int, relative_error: float
-) -> np.ndarray:
-    """Rank-normalized words: each value maps to its (approximate)
-    quantile bucket on ``bits`` bits — the skew-resistant alternative to
-    min/max scaling (reference: the percentile-based ZOrderField variant,
-    ZOrderField.scala:83+). A deterministic stride sample of size
-    ~1/relative_error² bounds the rank estimation error; equal values
-    always land in the same bucket (searchsorted is value-determined)."""
-    n = len(enc)
-    if n == 0:
-        return np.zeros(0, dtype=np.uint32)
-    top = np.float64((1 << bits) - 1)
-    max_sample = max(int(1.0 / max(relative_error, 1e-4) ** 2), 1024)
-    sample = enc if n <= max_sample else enc[:: max(1, n // max_sample)]
-    s = np.sort(sample)
-    pos = np.searchsorted(s, enc, side="right").astype(np.float64)
-    return ((pos / max(len(s), 1)) * top).astype(np.uint32)
+class ZOrderEncoder:
+    """FIXED per-column encoding spec -> z-address planes.
+
+    Freezing the spec and making plane computation a pure function of it
+    is what lets the streamed z-order build work: every wave, the spill
+    partitioner and the per-partition merge sort all encode IDENTICALLY,
+    so local sorted order equals global order. Spec kinds per column:
+
+    * ``("range", min_u64, max_u64)`` — min/max scaling of the numeric
+      order encoding;
+    * ``("quantile", sorted_bounds)`` — rank via binary search over
+      sampled boundaries (skew-resistant);
+    * ``("dict", sorted_strings)`` — GLOBAL lexicographic rank for string
+      columns. Batch-local dictionary ranks are NOT stable across waves,
+      so string encoding must always go through a frozen global
+      dictionary (rank normalization doubles as quantile normalization).
+    """
+
+    def __init__(self, bits: int, specs: List):
+        self.bits = bits
+        self.specs = specs
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def fit(
+        columns: List, bits: int, quantile: bool, relative_error: float
+    ):
+        """(encoder, per-column encodings) from in-memory Columns — the
+        encodings are returned so the caller never encodes twice."""
+        specs = []
+        encs = []
+        for col in columns:
+            if col.kind == "string":
+                spec = ("dict", sorted(set(col.dictionary)))
+                specs.append(spec)
+                encs.append(_dict_encode(col, spec[1]))
+                continue
+            e = order_u64_np(col)
+            encs.append(e)
+            if quantile:
+                max_sample = max(
+                    int(1.0 / max(relative_error, 1e-4) ** 2), 1024
+                )
+                sample = (
+                    e if len(e) <= max_sample else e[:: max(1, len(e) // max_sample)]
+                )
+                specs.append(("quantile", np.sort(sample)))
+            else:
+                specs.append(
+                    (
+                        "range",
+                        e.min() if len(e) else np.uint64(0),
+                        e.max() if len(e) else np.uint64(0),
+                    )
+                )
+        return ZOrderEncoder(bits, specs), encs
+
+    # -- encoding -----------------------------------------------------------
+    def encode(self, col, j: int) -> np.ndarray:
+        """Per-row uint64 order encoding of a Column under spec j."""
+        spec = self.specs[j]
+        if spec[0] == "dict":
+            return _dict_encode(col, spec[1])
+        return order_u64_np(col)
+
+    def _words(self, enc: np.ndarray, spec) -> np.ndarray:
+        bits = self.bits
+        top = (1 << bits) - 1
+        if spec[0] == "quantile":
+            bounds = spec[1]
+            pos = np.searchsorted(bounds, enc, side="right").astype(np.float64)
+            return ((pos / max(len(bounds), 1)) * np.float64(top)).astype(
+                np.uint32
+            )
+        if spec[0] == "dict":
+            # global ranks in [0, len]: plain range scaling over the rank
+            # space (rank IS the quantile of the unique-value distribution)
+            mn, mx = np.uint64(0), np.uint64(len(spec[1]))
+        else:
+            _tag, mn, mx = spec
+        # min/max scaling on host (per-wave word computation is O(n)
+        # elementwise; device dispatch pays transfers)
+        off = (enc - mn).astype(np.float64)
+        rng = float(int(mx) - int(mn))
+        scale = ((2.0**bits) - 1) / rng if rng > 0 else 0.0
+        return np.clip(off * scale, 0, top).astype(np.uint32)
+
+    def planes_from_encodings(self, encs: List[np.ndarray]) -> np.ndarray:
+        """[nplanes, n] uint32 planes (most-significant first) from
+        per-column encodings produced by :meth:`encode`."""
+        from hyperspace_tpu.ops import pad_len
+
+        n = len(encs[0]) if encs else 0
+        words = np.stack(
+            [self._words(e, s) for e, s in zip(encs, self.specs)]
+        ) if encs else np.zeros((0, 0), dtype=np.uint32)
+        n_pad = pad_len(max(n, 1))
+        if n_pad != n:
+            fill = np.full(
+                (words.shape[0], n_pad - n), np.uint32((1 << self.bits) - 1)
+            )
+            words = np.concatenate([words, fill], axis=1)
+        planes = np.asarray(_interleave(jnp.asarray(words), self.bits))
+        return planes[:, :n]
+
+    def planes(self, columns: List) -> np.ndarray:
+        return self.planes_from_encodings(
+            [self.encode(c, j) for j, c in enumerate(columns)]
+        )
+
+
+def _dict_encode(col, sorted_global: List[str]) -> np.ndarray:
+    """uint64 global lexicographic rank (+1; 0 = null) of a string
+    Column's values under a frozen sorted dictionary."""
+    local = col.dictionary
+    rank_of = np.searchsorted(np.array(sorted_global, dtype=object), local)
+    lut = np.asarray(rank_of, dtype=np.uint64) + np.uint64(1)
+    if len(lut) == 0:
+        lut = np.zeros(1, dtype=np.uint64)
+    enc = lut[np.maximum(col.codes, 0)]
+    return np.where(col.codes < 0, np.uint64(0), enc)
 
 
 def z_order_permutation(
@@ -119,53 +209,7 @@ def z_order_permutation(
     ZOrderCoveringIndex.scala:97-154). ``quantile=True`` switches from
     min/max scaling to quantile-bucket encoding (skewed columns keep
     using all address bits instead of collapsing onto a few)."""
-    from hyperspace_tpu.ops import pad_len
-
-    encs = [order_u64_np(c) for c in columns]
-    n = len(encs[0]) if encs else 0
-    n_pad = pad_len(max(n, 1))
-    if quantile:
-        word_rows = [_quantile_words_np(e, bits, relative_error) for e in encs]
-        if n_pad != n:
-            # pad rows take the max word so they sort last (shape policy)
-            fill = np.full(n_pad - n, np.uint32((1 << bits) - 1))
-            word_rows = [np.concatenate([w, fill]) for w in word_rows]
-        words = jnp.asarray(np.stack(word_rows))
-    else:
-        mins = [e.min() if len(e) else np.uint64(0) for e in encs]
-        maxs = [e.max() if len(e) else np.uint64(0) for e in encs]
-        if n_pad != n:
-            # pad rows encode as the max z-address and sort last (shape
-            # policy; lexsort_perm slices them off)
-            encs = [
-                np.concatenate(
-                    [e, np.full(n_pad - n, np.uint64(0xFFFFFFFFFFFFFFFF))]
-                )
-                for e in encs
-            ]
-        enc_hi = np.stack([(e >> np.uint64(32)).astype(np.uint32) for e in encs])
-        enc_lo = np.stack(
-            [(e & np.uint64(0xFFFFFFFF)).astype(np.uint32) for e in encs]
-        )
-        mins_hi = np.array(
-            [(m >> np.uint64(32)) for m in mins], dtype=np.uint32
-        )[:, None]
-        mins_lo = np.array(
-            [(m & np.uint64(0xFFFFFFFF)) for m in mins], dtype=np.uint32
-        )[:, None]
-        ranges = np.array(
-            [float(int(mx) - int(mn)) for mn, mx in zip(mins, maxs)],
-            dtype=np.float64,
-        )[:, None]
-        words = _normalize(
-            jnp.asarray(enc_hi),
-            jnp.asarray(enc_lo),
-            jnp.asarray(mins_hi),
-            jnp.asarray(mins_lo),
-            jnp.asarray(ranges),
-            bits,
-        )
-    planes = _interleave(words, bits)
     from hyperspace_tpu.ops.sort import lexsort_perm
 
-    return lexsort_perm(np.asarray(planes), n_valid=n)
+    enc, encs = ZOrderEncoder.fit(columns, bits, quantile, relative_error)
+    return lexsort_perm(enc.planes_from_encodings(encs))

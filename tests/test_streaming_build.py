@@ -144,18 +144,143 @@ class TestStreamingBuild:
         s = lambda t: t.sort_by([(c, "ascending") for c in t.column_names])
         assert s(got).equals(s(base))
 
-    def test_zorder_build_under_budget_materializes(
-        self, session, hs, wide_parquet
+    @pytest.mark.parametrize("quantile", [False, True], ids=["minmax", "qt"])
+    def test_zorder_streamed_equals_in_memory(
+        self, session, hs, wide_parquet, quantile, monkeypatch
     ):
-        """Z-order's global sort is not streamed: a budget-exceeding build
-        must materialize and succeed, not crash on the lazy scan."""
+        """The two-pass streamed z-order build (stats -> z-range spill ->
+        per-range merge) produces the SAME global row order as the
+        in-memory build, wave by wave, and never materializes more than a
+        wave (for min/max encoding, whose spec is sample-independent)."""
+        import pyarrow.parquet as pq_
+
+        from hyperspace_tpu.indexes.covering_build import SourceScan
         from hyperspace_tpu.indexes.zorder import ZOrderCoveringIndexConfig
 
-        session.conf.set(C.INDEX_BUILD_MEMORY_BUDGET, 1)
-        df = session.read.parquet(wide_parquet)
-        hs.create_index(df, ZOrderCoveringIndexConfig("z1", ["k"], ["v"]))
-        entry = session.index_manager.get_index_log_entry("z1")
-        assert entry is not None and entry.content.files
+        session.conf.set(C.ZORDER_QUANTILE_ENABLED, quantile)
+        session.conf.set(C.ZORDER_TARGET_SOURCE_BYTES_PER_PARTITION, 30_000)
+
+        def build(name, budget):
+            session.conf.set(C.INDEX_BUILD_MEMORY_BUDGET, budget)
+            df = session.read.parquet(wide_parquet)
+            hs.create_index(df, ZOrderCoveringIndexConfig(name, ["k"], ["v"]))
+            entry = session.index_manager.get_index_log_entry(name)
+            return sorted(entry.content.files)
+
+        calls = []
+        real = SourceScan.materialize
+
+        def tracking(self, files=None):
+            calls.append(len(files if files is not None else self.files))
+            return real(self, files)
+
+        monkeypatch.setattr(SourceScan, "materialize", tracking)
+        files_mem = build("zmem", 0)
+        assert not calls or max(calls) == 8  # in-memory: one full read
+        calls.clear()
+        from hyperspace_tpu.indexes.covering_build import (
+            estimated_materialized_bytes,
+        )
+
+        per_file = estimated_materialized_bytes(
+            [os.path.join(wide_parquet, sorted(os.listdir(wide_parquet))[0])],
+            "parquet",
+        )
+        files_stream = build("zstr", int(per_file * 2.5))
+        assert calls and max(calls) <= 2  # streamed: never > one wave
+        rows_mem = [pq_.read_table(f).to_pydict() for f in files_mem]
+        rows_str = [pq_.read_table(f).to_pydict() for f in files_stream]
+        flat = lambda parts: [
+            (k, v)
+            for p in parts
+            for k, v in zip(p["k"], p["v"])
+        ]
+        if quantile:
+            # quantile specs differ (global stride sample vs per-wave
+            # samples): same multiset of rows, both valid z-layouts
+            assert sorted(flat(rows_mem)) == sorted(flat(rows_str))
+        else:
+            # min/max spec is identical -> identical GLOBAL order
+            assert flat(rows_mem) == flat(rows_str)
+        # spill cleaned up
+        idx_dir = os.path.dirname(os.path.dirname(files_stream[0]))
+        for _root, dirs, _f in os.walk(idx_dir):
+            assert not [d for d in dirs if d.startswith("_spill_")]
+
+    def test_zorder_streamed_string_keys_global_order(
+        self, session, hs, tmp_path
+    ):
+        """String z-order keys must use a GLOBAL dictionary: wave-local
+        ranks would interleave unrelated ranges. Streamed output must
+        equal the in-memory build's global order."""
+        import pyarrow.parquet as pq_
+
+        from hyperspace_tpu.indexes.covering_build import (
+            estimated_materialized_bytes,
+        )
+        from hyperspace_tpu.indexes.zorder import ZOrderCoveringIndexConfig
+
+        rng = np.random.default_rng(11)
+        d = tmp_path / "zs"
+        d.mkdir()
+        # disjoint string ranges per file — the wave-local-rank failure mode
+        for i, prefix in enumerate(["a", "k", "t", "z"]):
+            t = pa.table(
+                {
+                    "s": pa.array(
+                        [f"{prefix}{v:04d}" for v in rng.integers(0, 500, 2000)]
+                    ),
+                    "v": pa.array(rng.normal(size=2000)),
+                }
+            )
+            pq_.write_table(t, d / f"f{i}.parquet")
+        session.conf.set(C.ZORDER_TARGET_SOURCE_BYTES_PER_PARTITION, 20_000)
+
+        def build(name, budget):
+            session.conf.set(C.INDEX_BUILD_MEMORY_BUDGET, budget)
+            df = session.read.parquet(str(d))
+            hs.create_index(df, ZOrderCoveringIndexConfig(name, ["s"], ["v"]))
+            entry = session.index_manager.get_index_log_entry(name)
+            return sorted(entry.content.files)
+
+        mem = build("zs_mem", 0)
+        per_file = estimated_materialized_bytes(
+            [str(d / "f0.parquet")], "parquet"
+        )
+        stream = build("zs_str", int(per_file * 1.5))
+        seq = lambda files: [
+            s for f in files for s in pq_.read_table(f).column("s").to_pylist()
+        ]
+        # single string key: z-order == lexicographic order, exactly equal
+        assert seq(stream) == seq(mem)
+        assert seq(stream) == sorted(seq(stream))
+
+    def test_zorder_streamed_constant_key_bounded(self, session, hs, tmp_path):
+        """A constant key funnels every row into one z-range; the merge
+        must split/fall back instead of materializing the whole dataset."""
+        import pyarrow.parquet as pq_
+
+        from hyperspace_tpu.indexes.zorder import ZOrderCoveringIndexConfig
+
+        d = tmp_path / "zc"
+        d.mkdir()
+        for i in range(4):
+            t = pa.table(
+                {
+                    "k": pa.array([7] * 2000, type=pa.int64()),
+                    "v": pa.array(np.arange(2000)),
+                }
+            )
+            pq_.write_table(t, d / f"f{i}.parquet")
+        session.conf.set(C.INDEX_BUILD_MEMORY_BUDGET, 1)  # pathological
+        session.conf.set(C.ZORDER_TARGET_SOURCE_BYTES_PER_PARTITION, 20_000)
+        df = session.read.parquet(str(d))
+        hs.create_index(df, ZOrderCoveringIndexConfig("zc", ["k"], ["v"]))
+        entry = session.index_manager.get_index_log_entry("zc")
+        total = sum(
+            pq_.read_table(f).num_rows for f in entry.content.files
+        )
+        assert total == 8000
 
     def test_incremental_refresh_streams_appended(
         self, session, hs, wide_parquet
