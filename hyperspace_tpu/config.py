@@ -151,6 +151,18 @@ class Config:
         )
 
     @property
+    def serve_cache_enabled(self) -> bool:
+        return self.get_bool(
+            C.SERVE_CACHE_ENABLED, C.SERVE_CACHE_ENABLED_DEFAULT
+        )
+
+    @property
+    def serve_cache_max_bytes(self) -> int:
+        return self.get_int(
+            C.SERVE_CACHE_MAX_BYTES, C.SERVE_CACHE_MAX_BYTES_DEFAULT
+        )
+
+    @property
     def default_supported_formats(self) -> set:
         raw = self.get_str(
             C.DEFAULT_SUPPORTED_FORMATS, C.DEFAULT_SUPPORTED_FORMATS_DEFAULT

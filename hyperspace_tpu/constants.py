@@ -173,3 +173,14 @@ EXECUTION_DEVICE_FILTER_MIN_ROWS_DEFAULT = 8_000_000
 # to force the device program on a single device once total rows reach it.
 EXECUTION_DEVICE_JOIN_MIN_ROWS = "hyperspace.execution.deviceJoinMinRows"
 EXECUTION_DEVICE_JOIN_MIN_ROWS_DEFAULT = 0  # 0 = never on single device
+
+# -- serve-server mode (execution/serve_cache.py) ----------------------------
+# Opt-in cache of decoded index data (batches, prepared join sides) in
+# host RAM, keyed by the immutable index file set — the data-plane
+# extension of the reference's metadata TTL cache
+# (CachingIndexCollectionManager.scala:38-108). First touch decodes and
+# retains; later queries skip parquet entirely. LRU-evicted by bytes.
+SERVE_CACHE_ENABLED = "hyperspace.serve.cache.enabled"
+SERVE_CACHE_ENABLED_DEFAULT = False
+SERVE_CACHE_MAX_BYTES = "hyperspace.serve.cache.maxBytes"
+SERVE_CACHE_MAX_BYTES_DEFAULT = 4 << 30  # 4 GiB

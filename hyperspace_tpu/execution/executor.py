@@ -44,6 +44,9 @@ def _exec(plan: LogicalPlan, needed: Set[str], session) -> ColumnarBatch:
         child = _bucket_pruned_scan(plan.child, plan.condition)
         child_needed = set(needed) | E.references(plan.condition)
         if isinstance(child, Scan):
+            cached = _cached_filter(child, plan.condition, child_needed, session)
+            if cached is not None:
+                return cached
             batch = _exec_scan(
                 child,
                 child_needed,
@@ -166,6 +169,169 @@ def _exec_limit(n: int, child: LogicalPlan, needed: Set[str], session) -> Column
     return batch.take(np.arange(min(n, batch.num_rows)))
 
 
+def _serve_cache(session):
+    """The session's ServeCache, or None when serve-server mode is off."""
+    if session is None:
+        return None
+    return session.serve_cache
+
+
+def _cacheable_scan(rel) -> bool:
+    """Only clean parquet-family scans are cached: no row-level delete
+    compensation, no injected partition constants (both are query-shaped
+    state that must not leak between queries)."""
+    return (
+        rel.fmt in ("parquet", "delta", "iceberg")
+        and rel.excluded_file_ids is None
+        and not rel.file_partition_values
+        and bool(rel.files)
+    )
+
+
+def _cached_filter(
+    scan: Scan, cond: E.Expr, child_needed: Set[str], session
+) -> Optional[ColumnarBatch]:
+    """Serve a Filter∘Scan from the serve cache (None = cache off/miss
+    path not applicable; caller runs the normal read).
+
+    On a cached key-sorted index bucket a pinned-key conjunct narrows the
+    candidate rows by binary search (``SortedSegmentState``) before the
+    full mask runs — the RAM-resident analogue of the parquet row-group
+    pruning the cold path gets from ``_pushdown_filters``, but without
+    re-reading anything.
+    """
+    cache = _serve_cache(session)
+    rel = scan.relation
+    if cache is None or not _cacheable_scan(rel):
+        return None
+    from hyperspace_tpu.execution.serve_cache import (
+        SortedSegmentState,
+        file_fingerprint,
+    )
+
+    fp = file_fingerprint(rel.files)
+    if fp is None:
+        return None
+    cols = tuple(c for c in rel.column_names if c in child_needed) or (
+        rel.column_names[0],
+    )
+    key = ("scan", fp, cols)
+    state = cache.get(key)
+    if state is None:
+        counts = pio.file_row_counts(list(rel.files))
+        table = pio.read_table(list(rel.files), list(cols), rel.fmt)
+        batch = ColumnarBatch.from_arrow(table)
+        segs = []
+        pos = 0
+        for c in counts:
+            segs.append((pos, pos + c))
+            pos += c
+        state = SortedSegmentState(batch, segs)
+        cache.put(key, state, state.nbytes)
+    batch = state.batch
+    idx = _sorted_narrow(state, cond, rel)
+    if idx is not None:
+        sub = batch.take(idx)
+        return sub.filter(_filter_mask(cond, sub, session))
+    return batch.filter(_filter_mask(cond, batch, session))
+
+
+def _sorted_narrow(state, cond: E.Expr, rel) -> Optional[np.ndarray]:
+    """Candidate row indices (ascending) from the first conjunct that can
+    binary-search a segment-sorted cached column, else None.
+
+    Soundness: the returned set must be a SUPERSET of the rows matching
+    the full condition (the caller re-applies the whole mask on the
+    subset). Equality/IN search by key rep is a superset for every type
+    (value equality ⇒ rep equality). Range conjuncts additionally need
+    rep order == value order, which holds for signed ints / temporals /
+    bools but NOT floats (sign-bit view) or strings (hashes) — those fall
+    through to the full mask.
+    """
+    cols = {c.lower(): c for c in rel.column_names}
+    import pyarrow as pa
+
+    def order_preserving(t: pa.DataType) -> bool:
+        return (
+            pa.types.is_signed_integer(t)
+            or pa.types.is_temporal(t)
+            or pa.types.is_boolean(t)
+        )
+
+    for cj in E.split_conjuncts(cond):
+        col = None
+        pts = None  # list of key reps for =/IN
+        bound = None  # (op, rep) for range conjuncts
+        norm = E.normalize_comparison(cj)
+        if norm is not None:
+            op, name, lit = norm
+            col = cols.get(name.lower())
+            if col is None or lit is None:
+                continue
+            rep = _literal_key_rep(lit, rel.schema[col])
+            if rep is None:
+                continue
+            if op == "=":
+                pts = [rep]
+            elif op in ("<", "<=", ">", ">=") and order_preserving(
+                rel.schema[col]
+            ):
+                bound = (op, rep)
+            else:
+                continue
+        elif isinstance(cj, E.In) and isinstance(cj.child, E.Col):
+            col = cols.get(cj.child.name.lower())
+            if col is None:
+                continue
+            vals = [v for v in cj.values if v is not None]
+            if not vals or len(vals) > _MAX_PRUNE_COMBOS:
+                continue
+            pts = []
+            for v in vals:
+                rep = _literal_key_rep(v, rel.schema[col])
+                if rep is None:
+                    pts = None
+                    break
+                pts.append(rep)
+            if pts is None:
+                continue
+        else:
+            continue
+        if col not in state.batch.columns:
+            continue
+        krep, sorted_ok = state.column_state(col)
+        if not sorted_ok:
+            continue
+        parts = []
+        for s, e in state.segments:
+            seg = krep[s:e]
+            if pts is not None:
+                for p in set(pts):
+                    a = int(np.searchsorted(seg, p, side="left"))
+                    b = int(np.searchsorted(seg, p, side="right"))
+                    if b > a:
+                        parts.append(np.arange(s + a, s + b, dtype=np.int64))
+            else:
+                op, rep = bound
+                if op == "<":
+                    a, b = 0, int(np.searchsorted(seg, rep, side="left"))
+                elif op == "<=":
+                    a, b = 0, int(np.searchsorted(seg, rep, side="right"))
+                elif op == ">":
+                    a, b = int(np.searchsorted(seg, rep, side="right")), e - s
+                else:  # >=
+                    a, b = int(np.searchsorted(seg, rep, side="left")), e - s
+                if b > a:
+                    parts.append(np.arange(s + a, s + b, dtype=np.int64))
+        if not parts:
+            return np.zeros(0, dtype=np.int64)
+        idx = np.concatenate(parts)
+        # ascending row order (IN points may interleave within a segment);
+        # ranges are disjoint after the per-point dedup, so no unique needed
+        return np.sort(idx)
+    return None
+
+
 def _exec_join(plan: Join, needed: Set[str], session) -> ColumnarBatch:
     pairs = E.equi_join_pairs(plan.condition)
     if pairs is None:
@@ -182,7 +348,10 @@ def _exec_join(plan: Join, needed: Set[str], session) -> ColumnarBatch:
     l_needed = (needed & lcols) | {l for l, _ in on}
     rcols = set(plan.right.output)
     r_needed = (needed & rcols) | {r for _, r in on}
-    from hyperspace_tpu.execution.join_exec import co_bucketed_join, inner_join
+    from hyperspace_tpu.execution.join_exec import (
+        co_bucketed_join_prepared,
+        inner_join,
+    )
 
     layout = _aligned_bucket_layouts(plan, on)
     if layout is not None:
@@ -190,14 +359,24 @@ def _exec_join(plan: Join, needed: Set[str], session) -> ColumnarBatch:
         # physical analogue of Spark SMJ over co-bucketed index scans with
         # no Exchange, JoinIndexRule.scala:619-634): the per-bucket merge
         # runs as one compiled program, buckets sharded across the mesh.
+        # Prepared sides (concat + key reps + sortedness) are retained by
+        # the serve cache, so a warm serve pays only match + assemble.
         num_buckets, l_bucket_cols, r_bucket_cols = layout
-        lbs = _exec_bucketed(plan.left, l_needed, session, l_bucket_cols)
-        rbs = _exec_bucketed(plan.right, r_needed, session, r_bucket_cols)
+        lp = _prepared_join_side(
+            plan.left, l_needed, session, l_bucket_cols, [l for l, _ in on]
+        )
+        rp = _prepared_join_side(
+            plan.right, r_needed, session, r_bucket_cols, [r for _, r in on]
+        )
         mesh = session.runtime.mesh if session is not None else None
         min_rows = (
             session.conf.device_join_min_rows if session is not None else 0
         )
-        joined = co_bucketed_join(lbs, rbs, on, mesh, min_rows)
+        joined = (
+            co_bucketed_join_prepared(lp, rp, on, mesh, min_rows)
+            if lp is not None and rp is not None
+            else None
+        )
         if joined is not None:
             return joined
         import pyarrow as pa
@@ -211,6 +390,43 @@ def _exec_join(plan: Join, needed: Set[str], session) -> ColumnarBatch:
     left = _exec(plan.left, l_needed, session)
     right = _exec(plan.right, r_needed, session)
     return inner_join(left, right, on)
+
+
+def _prepared_join_side(
+    plan: LogicalPlan, needed: Set[str], session, bucket_cols, key_cols
+):
+    """A PreparedJoinSide for one co-bucketed join child, served from the
+    serve cache when the child is a clean Project*(Scan) chain (the plan
+    shape of an index-only scan). Returns None for an empty side."""
+    from hyperspace_tpu.execution.join_exec import prepare_join_side
+
+    cache = _serve_cache(session)
+    key = None
+    if cache is not None:
+        node = plan
+        while isinstance(node, Project):
+            node = node.child
+        if isinstance(node, Scan) and _cacheable_scan(node.relation):
+            from hyperspace_tpu.execution.serve_cache import file_fingerprint
+
+            fp = file_fingerprint(node.relation.files)
+            if fp is not None:
+                key = (
+                    "joinside",
+                    fp,
+                    tuple(sorted(needed)),
+                    tuple(key_cols),
+                )
+                hit = cache.get(key)
+                if hit is not None:
+                    return hit
+    bs = _exec_bucketed(plan, needed, session, bucket_cols)
+    if not bs:
+        return None
+    prep = prepare_join_side(bs, key_cols)
+    if key is not None:
+        cache.put(key, prep, prep.nbytes)
+    return prep
 
 
 def _literal_key_rep(value, arrow_type):
@@ -463,10 +679,22 @@ def _exec_bucketed(
             cols = [c for c in rel.column_names if c in needed] or (
                 rel.column_names[:1]
             )
+            cache = _serve_cache(session)
+            key = None
+            if cache is not None and _cacheable_scan(rel):
+                from hyperspace_tpu.execution.serve_cache import (
+                    file_fingerprint,
+                )
+
+                fp = file_fingerprint(rel.files)
+                if fp is not None:
+                    key = ("bucketed", fp, tuple(cols))
+                    hit = cache.get(key)
+                    if hit is not None:
+                        return dict(hit)
             ordered = [(b, f) for b in sorted(groups) for f in groups[b]]
             counts = pio.file_row_counts([f for _, f in ordered])
             table = pio.read_table([f for _, f in ordered], cols, rel.fmt)
-            batch = ColumnarBatch.from_arrow(table)
             per_bucket = {}
             for (b, _f), c in zip(ordered, counts):
                 per_bucket[b] = per_bucket.get(b, 0) + c
@@ -474,8 +702,19 @@ def _exec_bucketed(
             pos = 0
             for b in sorted(groups):
                 c = per_bucket[b]
-                out[b] = batch.take(np.arange(pos, pos + c))
+                # zero-copy arrow slice per bucket, decoded directly —
+                # one decode copy total instead of decode-everything plus
+                # a gather per bucket
+                out[b] = ColumnarBatch.from_arrow(table.slice(pos, c))
                 pos += c
+            if key is not None:
+                from hyperspace_tpu.execution.serve_cache import batch_nbytes
+
+                cache.put(
+                    key,
+                    dict(out),
+                    sum(batch_nbytes(b) for b in out.values()),
+                )
             return out
         out = {}
         for b, files in groups.items():

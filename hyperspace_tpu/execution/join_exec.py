@@ -12,15 +12,24 @@ arithmetically (vectorized, no Python loop). Single-key matching is
 rep-exact; composite combines can collide, so multi-key joins re-verify
 the numeric key columns, and string key columns are always re-verified
 via dictionary remapping (murmur3-64 rep collisions), both O(matches).
+
+The co-bucketed path is split into *prepare* (concat buckets, key reps,
+combine, per-bucket sortedness — all query-independent) and *serve*
+(match + expand + verify + assemble). The prepared side is exactly what
+the serve cache (``execution/serve_cache.py``) retains between queries,
+so a warm serve pays only the per-query match work.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+import dataclasses
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from hyperspace_tpu.io.columnar import ColumnarBatch
+
+_SENTINEL_BASE = np.int64(-0x4000000000000000)
 
 
 def merge_join_indices(
@@ -107,123 +116,214 @@ def _assemble(
     return ColumnarBatch(out)
 
 
-def co_bucketed_join(
-    lbs: dict,
-    rbs: dict,
-    on: List[Tuple[str, str]],
-    mesh=None,
-    device_min_rows: int = 0,
-) -> Optional[ColumnarBatch]:
-    """Shuffle-free join of co-bucketed per-bucket batches.
+@dataclasses.dataclass
+class PreparedJoinSide:
+    """Query-independent serve state of one co-bucketed join side.
 
-    The matching work (argsort + binary-search ranges per bucket) runs as
-    ONE compiled device program vmapped over buckets and sharded over the
-    mesh (``ops/join.py``) — the TPU equivalent of the reference's
-    executor-parallel SMJ over co-bucketed scans
-    (``covering/JoinIndexRule.scala:619-634``). The host expands match
-    ranges (O(matches)) and re-verifies keys exactly.
+    Everything here is derived from the per-bucket batches alone: bucket
+    order, concatenated batch, per-bucket sizes/offsets, [k, n] key reps,
+    the combined int64 key, the null-key mask, and whether every bucket's
+    combined keys are already monotonic (true for clean single-version
+    covering-index scans, whose bucket files are key-sorted on disk).
+    The serve cache stores these keyed by the immutable index file set."""
 
-    Returns the joined batch, or None when the sides share no bucket (the
-    caller builds the schema-correct empty result).
-    """
-    from hyperspace_tpu.ops.join import bucketed_match_ranges, combine_reps_np
+    buckets: Tuple[int, ...]
+    batch: ColumnarBatch
+    sizes: np.ndarray  # [B] int64
+    offs: np.ndarray  # [B] int64
+    reps: np.ndarray  # [k, n] int64
+    combined: np.ndarray  # [n] int64 (no null sentinels applied)
+    nulls: Optional[np.ndarray]  # [n] bool, None when no null keys
+    sorted_buckets: bool
 
-    buckets = sorted(set(lbs) & set(rbs))
+    @property
+    def nbytes(self) -> int:
+        from hyperspace_tpu.execution.serve_cache import batch_nbytes
+
+        n = batch_nbytes(self.batch)
+        n += self.reps.nbytes + self.combined.nbytes
+        n += self.sizes.nbytes + self.offs.nbytes
+        if self.nulls is not None:
+            n += self.nulls.nbytes
+        return n
+
+    def subset(self, buckets: Tuple[int, ...]) -> "PreparedJoinSide":
+        """Restrict to a bucket subset (sides with mismatched bucket sets,
+        e.g. empty buckets on one side). Rebuilds contiguous arrays."""
+        if buckets == self.buckets:
+            return self
+        pos = {b: i for i, b in enumerate(self.buckets)}
+        idx_parts = []
+        sizes = []
+        for b in buckets:
+            i = pos[b]
+            o, s = int(self.offs[i]), int(self.sizes[i])
+            idx_parts.append(np.arange(o, o + s, dtype=np.int64))
+            sizes.append(s)
+        idx = (
+            np.concatenate(idx_parts)
+            if idx_parts
+            else np.zeros(0, dtype=np.int64)
+        )
+        sizes_a = np.array(sizes, dtype=np.int64)
+        offs = np.concatenate([[0], np.cumsum(sizes_a)[:-1]]).astype(np.int64)
+        nulls = None if self.nulls is None else self.nulls[idx]
+        if nulls is not None and not nulls.any():
+            nulls = None
+        return PreparedJoinSide(
+            buckets=tuple(buckets),
+            batch=self.batch.take(idx),
+            sizes=sizes_a,
+            offs=offs,
+            reps=self.reps[:, idx],
+            combined=self.combined[idx],
+            nulls=nulls,
+            sorted_buckets=self.sorted_buckets,
+        )
+
+
+def prepare_join_side(
+    bucket_batches: Dict[int, ColumnarBatch], key_cols: List[str]
+) -> PreparedJoinSide:
+    """Build the cacheable serve state from per-bucket batches."""
+    from hyperspace_tpu.ops.join import combine_reps_np
+
+    buckets = tuple(sorted(bucket_batches))
+    batch = ColumnarBatch.concat([bucket_batches[b] for b in buckets])
+    sizes = np.array(
+        [bucket_batches[b].num_rows for b in buckets], dtype=np.int64
+    )
+    offs = np.concatenate([[0], np.cumsum(sizes)[:-1]]).astype(np.int64)
+    reps = batch.key_reps(key_cols)
+    nulls_m = batch.null_any(key_cols)
+    nulls = nulls_m if nulls_m.any() else None
+    combined = combine_reps_np(reps)
+    n = combined.shape[0]
+    if n <= 1:
+        sorted_buckets = True
+    else:
+        ge = combined[1:] >= combined[:-1]
+        # bucket boundaries need not be ordered relative to each other;
+        # offs[i] == 0 means every earlier bucket is empty (no boundary)
+        starts = offs[1:]
+        cross_idx = starts[starts > 0] - 1
+        if len(cross_idx):
+            ge = ge.copy()
+            ge[cross_idx] = True
+        sorted_buckets = bool(np.all(ge))
+    return PreparedJoinSide(
+        buckets=buckets,
+        batch=batch,
+        sizes=sizes,
+        offs=offs,
+        reps=reps,
+        combined=combined,
+        nulls=nulls,
+        sorted_buckets=sorted_buckets,
+    )
+
+
+def _sentineled(prep: PreparedJoinSide, parity: int) -> np.ndarray:
+    """Combined keys with null rows overwritten by unique sentinels so a
+    null key can never match anything (SQL: null != null). Left uses even
+    offsets and right odd, so the two sides' sentinels never collide with
+    each other; a real key CAN equal a sentinel, which the caller guards
+    by numeric re-verification."""
+    if prep.nulls is None:
+        return prep.combined
+    combined = prep.combined.copy()
+    bad = np.nonzero(prep.nulls)[0]
+    combined[bad] = _SENTINEL_BASE - 2 * np.arange(len(bad)) - parity
+    return combined
+
+
+def _host_match(
+    lp: PreparedJoinSide,
+    rp: PreparedJoinSide,
+    l_comb: np.ndarray,
+    r_comb: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-bucket host match on the UNPADDED slices -> global (li, ri).
+
+    Sorted buckets binary-search directly; unsorted buckets (hybrid tails,
+    multi-key combines, multi-version buckets) are stable-argsorted on
+    host first — measured ~10x cheaper than the device sort+transfer
+    round trip on one chip. No [B, W] padding is built at all (the
+    padding only ever served the device kernel's static-shape contract)."""
+    li_parts: List[np.ndarray] = []
+    ri_parts: List[np.ndarray] = []
+    l_sorted = lp.sorted_buckets and lp.nulls is None
+    r_sorted = rp.sorted_buckets and rp.nulls is None
+    for b in range(len(lp.sizes)):
+        lsz, loff = int(lp.sizes[b]), int(lp.offs[b])
+        rsz, roff = int(rp.sizes[b]), int(rp.offs[b])
+        if lsz == 0 or rsz == 0:
+            continue
+        ls = l_comb[loff : loff + lsz]
+        rs = r_comb[roff : roff + rsz]
+        perm_l = perm_r = None
+        if not l_sorted:
+            perm_l = np.argsort(ls, kind="stable")
+            ls = ls[perm_l]
+        if not r_sorted:
+            perm_r = np.argsort(rs, kind="stable")
+            rs = rs[perm_r]
+        lo = np.searchsorted(rs, ls, side="left")
+        hi = np.searchsorted(rs, ls, side="right")
+        cnt = hi - lo
+        total = int(cnt.sum())
+        if total == 0:
+            continue
+        li_sorted = np.repeat(np.arange(lsz, dtype=np.int64), cnt)
+        starts = np.concatenate([[0], np.cumsum(cnt)[:-1]])
+        within = np.arange(total, dtype=np.int64) - np.repeat(starts, cnt)
+        ri_sorted = np.repeat(lo, cnt) + within
+        li = perm_l[li_sorted] if perm_l is not None else li_sorted
+        ri = perm_r[ri_sorted] if perm_r is not None else ri_sorted
+        li_parts.append(li + loff)
+        ri_parts.append(ri + roff)
     z = np.zeros(0, dtype=np.int64)
-    if not buckets:
-        return None
-    l_all = ColumnarBatch.concat([lbs[b] for b in buckets])
-    r_all = ColumnarBatch.concat([rbs[b] for b in buckets])
-    l_sizes = [lbs[b].num_rows for b in buckets]
-    r_sizes = [rbs[b].num_rows for b in buckets]
-    l_offs = np.concatenate([[0], np.cumsum(l_sizes)[:-1]]).astype(np.int64)
-    r_offs = np.concatenate([[0], np.cumsum(r_sizes)[:-1]]).astype(np.int64)
+    if not li_parts:
+        return z, z
+    return np.concatenate(li_parts), np.concatenate(ri_parts)
 
-    def side_arrays(batch, sizes, offs, cols, parity):
-        reps = batch.key_reps(cols)  # kept for exact verification below
-        ok = ~batch.null_any(cols)  # explicit masks, not the in-band rep
-        combined = combine_reps_np(reps)
-        # exclude null keys from matching (SQL: null never equals null):
-        # give each null row a unique sentinel; left uses even offsets and
-        # right odd, so the two sides' sentinels can never collide either
-        bad = np.nonzero(~ok)[0]
-        combined[bad] = (
-            np.int64(-0x4000000000000000) - 2 * np.arange(len(bad)) - parity
-        )
-        from hyperspace_tpu.ops import pad_len
 
-        # bucket width padded to a power of two (ops/__init__ shape policy:
-        # the match kernel compiles once per 2x band of max-bucket size)
-        width = pad_len(max(sizes) if sizes else 1)
-        B = len(sizes)
-        padded = np.full((B, width), np.int64(0x7FFFFFFFFFFFFFFF))
+def _device_match(
+    lp: PreparedJoinSide,
+    rp: PreparedJoinSide,
+    l_comb: np.ndarray,
+    r_comb: np.ndarray,
+    mesh,
+    device_min_rows: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad to the device kernel's static-shape contract, run the compiled
+    sharded match (``ops/join.bucketed_match_ranges``), expand ranges on
+    host -> global (li, ri)."""
+    from hyperspace_tpu.ops import pad_len
+    from hyperspace_tpu.ops.join import bucketed_match_ranges
+
+    def padded(prep, comb):
+        width = pad_len(int(prep.sizes.max()) if len(prep.sizes) else 1)
+        B = len(prep.sizes)
+        pad = np.full((B, width), np.int64(0x7FFFFFFFFFFFFFFF))
         rowmap = np.zeros((B, width), dtype=np.int64)
-        for i, (sz, off) in enumerate(zip(sizes, offs)):
-            padded[i, :sz] = combined[off : off + sz]
+        for i in range(B):
+            sz, off = int(prep.sizes[i]), int(prep.offs[i])
+            pad[i, :sz] = comb[off : off + sz]
             rowmap[i, :sz] = np.arange(off, off + sz)
-        return padded, np.array(sizes, dtype=np.int64), rowmap, reps
+        return pad, rowmap
 
-    l_pad, l_len, l_rowmap, l_reps = side_arrays(
-        l_all, l_sizes, l_offs, [l for l, _ in on], 0
-    )
-    r_pad, r_len, r_rowmap, r_reps = side_arrays(
-        r_all, r_sizes, r_offs, [r for _, r in on], 1
-    )
-    # PRESORTED fast path: covering-index buckets are key-sorted on disk,
-    # so for single-key joins over clean index scans the combined keys
-    # arrive already monotonic per bucket (pads are +max at the tail).
-    # Re-sorting them on device per query is the single largest serve
-    # cost (measured: 3.5-5.5s of a ~6.5s 4M-row join) — detect
-    # monotonicity in O(n) and binary-search directly. Multi-key combines
-    # (hash, not order-preserving), hybrid-appended tails, null sentinels
-    # and multi-version buckets all fail the check and take the general
-    # sort path; correctness never depends on the hint.
-    from hyperspace_tpu.ops.join import presorted_match_ranges, rows_monotonic
-
-    single_device = mesh is None or mesh.devices.size <= 1
-    total = int(l_len.sum() + r_len.sum())
-    force_device = (
-        single_device and device_min_rows > 0 and total >= device_min_rows
-    )
-    sorted_l, sorted_r = rows_monotonic(l_pad), rows_monotonic(r_pad)
-    if (sorted_l and sorted_r) or (single_device and not force_device):
-        # the pow2 bucket-width padding only serves the device kernel's
-        # compile cache; numpy has no static-shape constraint, so the
-        # host branch trims back to the real max bucket width
-        w_l = max(max(l_sizes) if l_sizes else 1, 1)
-        w_r = max(max(r_sizes) if r_sizes else 1, 1)
-        l_pad, l_rowmap = l_pad[:, :w_l], l_rowmap[:, :w_l]
-        r_pad, r_rowmap = r_pad[:, :w_r], r_rowmap[:, :w_r]
-        # Not-sorted sides (hybrid tails, multi-key combines, multi-version
-        # buckets) are stable-argsorted on HOST first: measured ~10x
-        # cheaper than the device sort+transfer round trip on one chip.
-        # On a >1-device mesh the device path wins (sort parallelizes
-        # across shards); deviceJoinMinRows > 0 forces it on one device.
-        if sorted_l:
-            perm_l = np.broadcast_to(
-                np.arange(l_pad.shape[1]), l_pad.shape
-            )
-        else:
-            perm_l = np.argsort(l_pad, axis=1, kind="stable")
-            l_pad = np.take_along_axis(l_pad, perm_l, axis=1)
-        if sorted_r:
-            perm_r = np.broadcast_to(
-                np.arange(r_pad.shape[1]), r_pad.shape
-            )
-        else:
-            perm_r = np.argsort(r_pad, axis=1, kind="stable")
-            r_pad = np.take_along_axis(r_pad, perm_r, axis=1)
-        _pl, _pr, lo, cnt = presorted_match_ranges(l_pad, l_len, r_pad, r_len)
-        return _expand_and_assemble(
-            l_all, r_all, on, l_reps, r_reps,
-            l_rowmap, r_rowmap, l_len, perm_l, perm_r, lo, cnt, z,
-        )
+    l_pad, l_rowmap = padded(lp, l_comb)
+    r_pad, r_rowmap = padded(rp, r_comb)
+    l_len = lp.sizes.copy()
+    r_len = rp.sizes.copy()
     # pad the bucket dimension so shard_map divides evenly
     if mesh is not None and mesh.devices.size > 1:
         D = mesh.devices.size
         B = l_pad.shape[0]
         extra = (-B) % D
         if extra:
+
             def grow(a, fill):
                 pad = np.full((extra,) + a.shape[1:], fill, dtype=a.dtype)
                 return np.concatenate([a, pad])
@@ -237,19 +337,6 @@ def co_bucketed_join(
     perm_l, perm_r, lo, cnt = bucketed_match_ranges(
         mesh, l_pad, l_len, r_pad, r_len, device_min_rows
     )
-    return _expand_and_assemble(
-        l_all, r_all, on, l_reps, r_reps,
-        l_rowmap, r_rowmap, l_len, perm_l, perm_r, lo, cnt, z,
-    )
-
-
-def _expand_and_assemble(
-    l_all, r_all, on, l_reps, r_reps,
-    l_rowmap, r_rowmap, l_len, perm_l, perm_r, lo, cnt, z,
-):
-    """Expand per-bucket match ranges into row pairs (O(matches),
-    vectorized), re-verify keys exactly, assemble the output batch —
-    shared by the presorted fast path and the general device/host path."""
     li_parts, ri_parts = [], []
     for b in range(len(l_len)):
         total = int(cnt[b].sum())
@@ -262,14 +349,85 @@ def _expand_and_assemble(
         ri_sorted = lo[b][li_sorted] + within
         li_parts.append(l_rowmap[b][perm_l[b][li_sorted]])
         ri_parts.append(r_rowmap[b][perm_r[b][ri_sorted]])
+    z = np.zeros(0, dtype=np.int64)
     if not li_parts:
-        return _assemble(l_all, r_all, z, z)
-    li = np.concatenate(li_parts)
-    ri = np.concatenate(ri_parts)
-    # numeric verification guards combine-hash and null-sentinel
-    # collisions (a real key value can equal another row's sentinel)
-    li, ri = _verify_keys(l_all, r_all, on, li, ri, l_reps, r_reps)
-    return _assemble(l_all, r_all, li, ri)
+        return z, z
+    return np.concatenate(li_parts), np.concatenate(ri_parts)
+
+
+def co_bucketed_join_prepared(
+    lp: PreparedJoinSide,
+    rp: PreparedJoinSide,
+    on: List[Tuple[str, str]],
+    mesh=None,
+    device_min_rows: int = 0,
+) -> Optional[ColumnarBatch]:
+    """Shuffle-free join of two prepared co-bucketed sides.
+
+    The TPU equivalent of the reference's executor-parallel SMJ over
+    co-bucketed scans (``covering/JoinIndexRule.scala:619-634``): no
+    exchange ever happens — each bucket pair is matched independently
+    (host binary-search per bucket, or the compiled sharded device
+    program on a >1-device mesh).
+
+    Returns the joined batch, or None when the sides share no bucket (the
+    caller builds the schema-correct empty result).
+    """
+    common = tuple(sorted(set(lp.buckets) & set(rp.buckets)))
+    if not common:
+        return None
+    lp = lp.subset(common)
+    rp = rp.subset(common)
+    l_comb = _sentineled(lp, 0)
+    r_comb = _sentineled(rp, 1)
+    both_sorted = (
+        lp.sorted_buckets
+        and rp.sorted_buckets
+        and lp.nulls is None
+        and rp.nulls is None
+    )
+    single_device = mesh is None or mesh.devices.size <= 1
+    total = int(lp.sizes.sum() + rp.sizes.sum())
+    force_device = (
+        single_device and device_min_rows > 0 and total >= device_min_rows
+    )
+    # PRESORTED fast path: covering-index buckets are key-sorted on disk,
+    # so single-key joins over clean index scans binary-search directly —
+    # re-sorting per query was the single largest serve cost (measured
+    # 3.5-5.5s of a ~6.5s 4M-row join before round 4). The host branch
+    # also wins for unsorted sides on one device (argsort on host beats
+    # the device round trip); a >1-device mesh shards the general path.
+    if both_sorted or (single_device and not force_device):
+        li, ri = _host_match(lp, rp, l_comb, r_comb)
+    else:
+        li, ri = _device_match(lp, rp, l_comb, r_comb, mesh, device_min_rows)
+    # Single-key matching on the raw combined reps is exact (identity
+    # combine, no sentinels in play when no side has null keys): only the
+    # string hash-collision guard is needed. Multi-key combines can
+    # collide, and sentinels can equal real keys — both require the
+    # numeric re-verification.
+    sentinels_used = lp.nulls is not None or rp.nulls is not None
+    verify_numeric = len(on) > 1 or sentinels_used
+    li, ri = _verify_keys(
+        lp.batch, rp.batch, on, li, ri, lp.reps, rp.reps, verify_numeric
+    )
+    return _assemble(lp.batch, rp.batch, li, ri)
+
+
+def co_bucketed_join(
+    lbs: dict,
+    rbs: dict,
+    on: List[Tuple[str, str]],
+    mesh=None,
+    device_min_rows: int = 0,
+) -> Optional[ColumnarBatch]:
+    """Prepare both sides then serve (see ``co_bucketed_join_prepared``).
+    Entry point for callers without a serve cache."""
+    if not lbs or not rbs:
+        return None
+    lp = prepare_join_side(lbs, [l for l, _ in on])
+    rp = prepare_join_side(rbs, [r for _, r in on])
+    return co_bucketed_join_prepared(lp, rp, on, mesh, device_min_rows)
 
 
 def inner_join(

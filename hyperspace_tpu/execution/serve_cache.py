@@ -1,0 +1,149 @@
+"""Serve-server mode: an in-memory cache of immutable index data.
+
+The reference caches index *metadata* with a TTL
+(``index/CachingIndexCollectionManager.scala:38-108``); the data itself is
+re-read from the lake on every query because Spark executors are
+stateless. A TPU serve process is not — host RAM (and HBM) can hold the
+hot index buckets between queries, which converts the serve path from
+parquet-read-bound to compute-bound. This module is that cache.
+
+Correctness model: entries are keyed by a **fingerprint of the exact file
+set** — (path, size, mtime_ns) per file. Index data files are immutable
+once written (every refresh/optimize writes a new ``v__=N`` version
+directory, ``metadata/data_manager.py``), so a stale entry's key simply
+never matches again; no invalidation protocol is needed. Eviction is LRU
+by byte size (``hyperspace.serve.cache.maxBytes``).
+
+Opt-in via ``hyperspace.serve.cache.enabled`` (constants.py) — the cold
+path behaves exactly as before. What gets cached (see
+``execution/executor.py``):
+
+* ``("scan", fp, cols)`` — the decoded ColumnarBatch of a clean index
+  scan + lazily-computed per-column sorted-segment state for the
+  binary-search point-lookup fast path;
+* ``("joinside", fp, cols, keys)`` — a ``PreparedJoinSide``
+  (``execution/join_exec.py``): concat batch, key reps, combined keys,
+  per-bucket offsets and sortedness;
+* ``("bucketed", fp, cols)`` — per-bucket batches for hybrid-scan serves.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+from hyperspace_tpu.io.columnar import ColumnarBatch
+
+
+def file_fingerprint(files) -> Optional[Tuple]:
+    """(path, size, mtime_ns) per file — the cache key component that makes
+    stale entries unreachable. None when any file is missing (caller skips
+    the cache and lets the normal read path raise its own error)."""
+    out = []
+    try:
+        for f in files:
+            st = os.stat(f)
+            out.append((f, st.st_size, st.st_mtime_ns))
+    except OSError:
+        return None
+    return tuple(out)
+
+
+def batch_nbytes(batch: ColumnarBatch) -> int:
+    """Approximate resident bytes of a batch (arrays + dictionaries)."""
+    total = 0
+    for c in batch.columns.values():
+        for a in (c.values, c.codes, c.validity):
+            if a is not None:
+                total += a.nbytes
+        if c.dictionary:
+            total += sum(len(s) + 49 for s in c.dictionary)
+    return total
+
+
+class ServeCache:
+    """Thread-safe LRU cache, byte-capped. Values carry their own size
+    (entries are (value, nbytes) internally)."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._entries: OrderedDict = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key):
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return entry[0]
+
+    def put(self, key, value, nbytes: int) -> None:
+        if nbytes > self.max_bytes:
+            return  # larger than the whole cache: not cacheable
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._entries[key] = (value, nbytes)
+            self._bytes += nbytes
+            while self._bytes > self.max_bytes and self._entries:
+                _, (_, freed) = self._entries.popitem(last=False)
+                self._bytes -= freed
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    @property
+    def resident_bytes(self) -> int:
+        return self._bytes
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+class SortedSegmentState:
+    """Lazily-computed sorted-segment view of one cached scan column.
+
+    Index bucket files are key-sorted on disk; after an incremental
+    refresh a bucket holds several files, each sorted but not globally
+    merged. The cached batch keeps the per-file segment boundaries and,
+    per column, whether every segment is monotonic in key-rep order —
+    detected from the data (never trusted from metadata), the same
+    doctrine as the join's presorted fast path."""
+
+    def __init__(self, batch: ColumnarBatch, segments):
+        self.batch = batch
+        self.segments = tuple(segments)  # ((start, end), ...)
+        self._cols: dict = {}
+
+    def column_state(self, name: str):
+        """(key_rep, all_segments_sorted) for a column, memoized."""
+        import numpy as np
+
+        st = self._cols.get(name)
+        if st is not None:
+            return st
+        rep = self.batch.column(name).key_rep()
+        ok = True
+        for s, e in self.segments:
+            seg = rep[s:e]
+            if len(seg) > 1 and not bool(np.all(seg[1:] >= seg[:-1])):
+                ok = False
+                break
+        st = (rep, ok)
+        self._cols[name] = st
+        return st
+
+    @property
+    def nbytes(self) -> int:
+        return batch_nbytes(self.batch)

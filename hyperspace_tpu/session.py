@@ -122,6 +122,7 @@ class HyperspaceSession:
         self._hyperspace_enabled = False
         self._source_manager = None
         self._index_manager = None
+        self._serve_cache = None
         self._catalog: dict = {}
 
     # -- context (HyperspaceContext, Hyperspace.scala:195-223) --------------
@@ -140,6 +141,25 @@ class HyperspaceSession:
 
             self._index_manager = CachingIndexCollectionManager(self)
         return self._index_manager
+
+    @property
+    def serve_cache(self):
+        """The serve-server data cache (``execution/serve_cache.py``) when
+        ``hyperspace.serve.cache.enabled`` is on, else None. Stale entries
+        are impossible (keys fingerprint the immutable index file set);
+        ``clear_serve_cache()`` just frees the memory."""
+        if not self.conf.serve_cache_enabled:
+            return None
+        max_bytes = self.conf.serve_cache_max_bytes
+        if self._serve_cache is None or self._serve_cache.max_bytes != max_bytes:
+            from hyperspace_tpu.execution.serve_cache import ServeCache
+
+            self._serve_cache = ServeCache(max_bytes)
+        return self._serve_cache
+
+    def clear_serve_cache(self) -> None:
+        if self._serve_cache is not None:
+            self._serve_cache.clear()
 
     # -- reading ------------------------------------------------------------
     @property

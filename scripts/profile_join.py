@@ -1,0 +1,184 @@
+"""Per-stage profile of the 4M-row join serve paths (real chip).
+
+Times the indexed co-bucketed join, the unindexed join, and the hybrid
+unindexed join (the VERDICT r4 anomaly), with monkeypatched stage timers.
+Throwaway diagnostic — not part of the test suite.
+"""
+import cProfile
+import io
+import json
+import os
+import pstats
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import gen_data, log
+
+STAGES = {}
+
+
+def timed(name, fn):
+    def wrap(*a, **k):
+        t0 = time.perf_counter()
+        out = fn(*a, **k)
+        STAGES[name] = STAGES.get(name, 0.0) + time.perf_counter() - t0
+        return out
+
+    return wrap
+
+
+def main():
+    n_items = int(os.environ.get("HS_BENCH_ROWS", 4_000_000))
+    n_orders = max(n_items // 8, 1)
+
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.hyperspace import Hyperspace
+    from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+    from hyperspace_tpu.session import HyperspaceSession
+
+    tmp = tempfile.mkdtemp(prefix="hs_prof_")
+    try:
+        items_dir, orders_dir = gen_data(tmp, n_items, n_orders)
+        session = HyperspaceSession()
+        session.conf.set(C.INDEX_SYSTEM_PATH, os.path.join(tmp, "indexes"))
+        session.conf.set(C.INDEX_NUM_BUCKETS, 8)
+        hs = Hyperspace(session)
+        items = session.read.parquet(items_dir)
+        orders = session.read.parquet(orders_dir)
+        hs.create_index(
+            items,
+            CoveringIndexConfig(
+                "l_idx", ["l_orderkey"], ["l_shipdate", "l_quantity", "l_extendedprice"]
+            ),
+        )
+        hs.create_index(
+            orders, CoveringIndexConfig("o_idx", ["o_orderkey"], ["o_custkey", "o_totalprice"])
+        )
+
+        def q_join(o, i):
+            return o.join(i, on=o["o_orderkey"] == i["l_orderkey"]).select(
+                "o_orderkey", "o_custkey", "l_quantity"
+            )
+
+        # --- instrument executor internals
+        from hyperspace_tpu.execution import executor as X
+        from hyperspace_tpu.execution import join_exec as J
+        from hyperspace_tpu.io import parquet as pio
+        from hyperspace_tpu.io.columnar import ColumnarBatch
+
+        X._exec_bucketed = timed("exec_bucketed", X._exec_bucketed)
+        orig_read = pio.read_table
+        pio.read_table = timed("pio.read_table", orig_read)
+        J_co = J.co_bucketed_join
+
+        def co_timed(lbs, rbs, on, mesh=None, device_min_rows=0):
+            t0 = time.perf_counter()
+            out = J_co(lbs, rbs, on, mesh, device_min_rows)
+            STAGES["co_bucketed_join"] = (
+                STAGES.get("co_bucketed_join", 0.0) + time.perf_counter() - t0
+            )
+            return out
+
+        X.co_bucketed_join_patch = co_timed
+        # executor imports co_bucketed_join inside _exec_join; patch module
+        J.co_bucketed_join_orig = J_co
+        J.co_bucketed_join = co_timed
+        J._expand_and_assemble = timed("expand_assemble", J._expand_and_assemble)
+        J._verify_keys = timed("verify_keys", J._verify_keys)
+        J._assemble = timed("assemble", J._assemble)
+        cb_concat = ColumnarBatch.concat
+        ColumnarBatch.concat = staticmethod(timed("batch_concat", cb_concat))
+        to_arrow = ColumnarBatch.to_arrow
+        ColumnarBatch.to_arrow = timed("to_arrow", to_arrow)
+        # co_bucketed_join imports these lazily from ops.join — patch there
+        from hyperspace_tpu.ops import join as OJ
+
+        OJ.presorted_match_ranges = timed(
+            "presorted_match", OJ.presorted_match_ranges
+        )
+        OJ.bucketed_match_ranges = timed(
+            "bucketed_match", OJ.bucketed_match_ranges
+        )
+        cb_key_reps = ColumnarBatch.key_reps
+        ColumnarBatch.key_reps = timed("key_reps", cb_key_reps)
+
+        session.enable_hyperspace()
+        q_join(orders, items).collect()  # warm
+        for name in ("indexed_join",):
+            STAGES.clear()
+            t0 = time.perf_counter()
+            q_join(orders, items).collect()
+            total = time.perf_counter() - t0
+            log(f"--- {name}: total {total*1e3:.1f}ms")
+            for k, v in sorted(STAGES.items(), key=lambda kv: -kv[1]):
+                log(f"    {k:24s} {v*1e3:8.1f}ms")
+
+        # cProfile for detail
+        pr = cProfile.Profile()
+        pr.enable()
+        q_join(orders, items).collect()
+        pr.disable()
+        s = io.StringIO()
+        pstats.Stats(pr, stream=s).sort_stats("cumulative").print_stats(25)
+        log(s.getvalue())
+
+        session.disable_hyperspace()
+        q_join(orders, items).collect()  # warm
+        STAGES.clear()
+        t0 = time.perf_counter()
+        q_join(orders, items).collect()
+        total = time.perf_counter() - t0
+        log(f"--- unindexed_join: total {total*1e3:.1f}ms")
+        for k, v in sorted(STAGES.items(), key=lambda kv: -kv[1]):
+            log(f"    {k:24s} {v*1e3:8.1f}ms")
+
+        pr = cProfile.Profile()
+        pr.enable()
+        q_join(orders, items).collect()
+        pr.disable()
+        s = io.StringIO()
+        pstats.Stats(pr, stream=s).sort_stats("cumulative").print_stats(25)
+        log(s.getvalue())
+
+        # hybrid anomaly: append ~3% then unindexed join again
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        n_extra = max(n_items // 32, 1)
+        extra = pa.table(
+            {
+                "l_orderkey": np.random.default_rng(9).integers(0, n_orders, n_extra),
+                "l_shipdate": pa.array(np.full(n_extra, np.datetime64("1998-01-01"))),
+                "l_quantity": np.full(n_extra, 7, dtype=np.int64),
+                "l_extendedprice": np.full(n_extra, 1.0),
+            }
+        )
+        pq.write_table(extra, os.path.join(items_dir, "appended.parquet"))
+        items2 = session.read.parquet(items_dir)
+        q_join(orders, items2).collect()  # warm
+        STAGES.clear()
+        t0 = time.perf_counter()
+        q_join(orders, items2).collect()
+        total = time.perf_counter() - t0
+        log(f"--- unindexed_hybrid_join: total {total*1e3:.1f}ms")
+        for k, v in sorted(STAGES.items(), key=lambda kv: -kv[1]):
+            log(f"    {k:24s} {v*1e3:8.1f}ms")
+        pr = cProfile.Profile()
+        pr.enable()
+        q_join(orders, items2).collect()
+        pr.disable()
+        s = io.StringIO()
+        pstats.Stats(pr, stream=s).sort_stats("cumulative").print_stats(25)
+        log(s.getvalue())
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,345 @@
+"""Serve-server mode: the in-RAM index data cache.
+
+The data-plane extension of the reference's metadata TTL cache
+(``CachingIndexCollectionManager.scala:38-108``). Tests follow the
+project's differential doctrine: every cached serve must return exactly
+what the uncached serve returns, across filter shapes, joins, hybrid
+scans and refresh-driven invalidation.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.execution.serve_cache import (
+    ServeCache,
+    SortedSegmentState,
+    batch_nbytes,
+    file_fingerprint,
+)
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+from hyperspace_tpu.io.columnar import ColumnarBatch
+
+
+def sorted_table(t: pa.Table) -> pa.Table:
+    return t.sort_by([(c, "ascending") for c in t.column_names])
+
+
+class TestServeCacheUnit:
+    def test_lru_eviction_by_bytes(self):
+        c = ServeCache(max_bytes=100)
+        c.put("a", 1, 40)
+        c.put("b", 2, 40)
+        assert c.get("a") == 1  # touch a: b becomes LRU
+        c.put("c", 3, 40)  # evicts b
+        assert c.get("b") is None
+        assert c.get("a") == 1
+        assert c.get("c") == 3
+        assert c.resident_bytes == 80
+
+    def test_oversized_value_not_cached(self):
+        c = ServeCache(max_bytes=10)
+        c.put("big", 1, 11)
+        assert c.get("big") is None
+        assert len(c) == 0
+
+    def test_replace_updates_bytes(self):
+        c = ServeCache(max_bytes=100)
+        c.put("a", 1, 60)
+        c.put("a", 2, 30)
+        assert c.resident_bytes == 30
+        assert c.get("a") == 2
+
+    def test_hit_miss_counters(self):
+        c = ServeCache(max_bytes=100)
+        c.get("x")
+        c.put("x", 1, 1)
+        c.get("x")
+        assert (c.hits, c.misses) == (1, 1)
+
+    def test_clear(self):
+        c = ServeCache(max_bytes=100)
+        c.put("a", 1, 10)
+        c.clear()
+        assert c.get("a") is None
+        assert c.resident_bytes == 0
+
+
+class TestFingerprint:
+    def test_changes_with_content(self, tmp_path):
+        p = tmp_path / "f.parquet"
+        pq.write_table(pa.table({"a": [1, 2]}), str(p))
+        fp1 = file_fingerprint([str(p)])
+        os.utime(str(p), ns=(1, 1))  # mtime change → new fingerprint
+        fp2 = file_fingerprint([str(p)])
+        assert fp1 != fp2
+
+    def test_missing_file_returns_none(self, tmp_path):
+        assert file_fingerprint([str(tmp_path / "nope")]) is None
+
+
+class TestSortedSegmentState:
+    def _batch(self, values):
+        return ColumnarBatch.from_arrow(
+            pa.table({"k": pa.array(values, type=pa.int64())})
+        )
+
+    def test_sorted_segments_detected(self):
+        st = SortedSegmentState(self._batch([1, 5, 9, 2, 3]), [(0, 3), (3, 5)])
+        rep, ok = st.column_state("k")
+        assert ok
+        assert rep.tolist() == [1, 5, 9, 2, 3]
+
+    def test_unsorted_segment_detected(self):
+        st = SortedSegmentState(self._batch([1, 5, 3]), [(0, 3)])
+        _, ok = st.column_state("k")
+        assert not ok
+
+    def test_memoized(self):
+        st = SortedSegmentState(self._batch([1, 2]), [(0, 2)])
+        assert st.column_state("k") is st.column_state("k")
+
+    def test_nbytes_positive(self):
+        st = SortedSegmentState(self._batch([1, 2]), [(0, 2)])
+        assert st.nbytes > 0
+        assert batch_nbytes(st.batch) == st.nbytes
+
+
+@pytest.fixture
+def hs(session):
+    return Hyperspace(session)
+
+
+def _lineitem(tmp_path, n=4000, n_files=4, with_floats=True):
+    rng = np.random.default_rng(11)
+    d = tmp_path / "tbl"
+    d.mkdir()
+    t = pa.table(
+        {
+            "k": rng.integers(0, 500, n).astype(np.int64),
+            "d": pa.array(
+                (
+                    np.datetime64("1994-01-01")
+                    + rng.integers(0, 900, n).astype("timedelta64[D]")
+                ).astype("datetime64[D]")
+            ),
+            "q": rng.integers(1, 51, n).astype(np.int64),
+            "p": rng.normal(100.0, 30.0, n),
+            "s": pa.array([f"s{v % 7}" for v in range(n)]),
+        }
+    )
+    per = n // n_files
+    for i in range(n_files):
+        pq.write_table(
+            t.slice(i * per, per if i < n_files - 1 else n - i * per),
+            str(d / f"part{i}.parquet"),
+        )
+    return str(d)
+
+
+class TestCachedFilterDifferential:
+    """Cached serve == uncached serve for every filter shape, and the
+    cache actually hits."""
+
+    QUERIES = [
+        lambda df: df.filter(df["k"] == 123).select("k", "q"),
+        lambda df: df.filter(df["k"] == -1).select("k"),  # empty result
+        lambda df: df.filter(df["k"] < 30).select("k", "q", "p"),
+        lambda df: df.filter(df["k"] >= 480).select("k", "d"),
+        lambda df: df.filter(df["k"].isin(3, 490, 77)).select("k", "q"),
+        lambda df: df.filter((df["k"] == 123) & (df["q"] > 25)).select("k", "q"),
+        # float predicate column: narrowing must refuse range-by-rep
+        lambda df: df.filter((df["k"] == 123) & (df["p"] < 100.0)).select("k", "p"),
+        # string equality
+        lambda df: df.filter((df["k"] == 123) & (df["s"] == "s3")).select("k", "s"),
+    ]
+
+    def test_filter_shapes(self, session, hs, tmp_path):
+        src = _lineitem(tmp_path)
+        df = session.read.parquet(src)
+        hs.create_index(
+            df, CoveringIndexConfig("ix", ["k"], ["d", "q", "p", "s"])
+        )
+        session.conf.set(C.INDEX_FILTER_RULE_USE_BUCKET_SPEC, True)
+        session.enable_hyperspace()
+        expected = [sorted_table(q(df).collect()) for q in self.QUERIES]
+        session.conf.set(C.SERVE_CACHE_ENABLED, True)
+        # twice: first populates, second must hit
+        for _ in range(2):
+            for q, exp in zip(self.QUERIES, expected):
+                got = sorted_table(q(df).collect())
+                assert got.equals(exp)
+        assert session.serve_cache.hits > 0
+        session.conf.set(C.SERVE_CACHE_ENABLED, False)
+
+    def test_refresh_invalidates_by_fingerprint(self, session, hs, tmp_path):
+        src = _lineitem(tmp_path)
+        df = session.read.parquet(src)
+        session.conf.set(C.INDEX_LINEAGE_ENABLED, True)
+        hs.create_index(df, CoveringIndexConfig("ix", ["k"], ["q"]))
+        session.conf.set(C.SERVE_CACHE_ENABLED, True)
+        session.enable_hyperspace()
+        q = lambda d: d.filter(d["k"] == 123).select("k", "q")
+        before = q(df).collect().num_rows
+        assert before == q(df).collect().num_rows  # cache populated
+        # append source rows with k=123 and refresh incrementally: the new
+        # index version has new files → new fingerprints → no stale serve
+        extra = pa.table(
+            {
+                "k": pa.array([123] * 5, type=pa.int64()),
+                "d": pa.array(np.full(5, np.datetime64("1998-01-01"), dtype="datetime64[D]")),
+                "q": pa.array([7] * 5, type=pa.int64()),
+                "p": pa.array([1.0] * 5),
+                "s": pa.array(["sX"] * 5),
+            }
+        )
+        pq.write_table(extra, os.path.join(src, "extra.parquet"))
+        hs.refresh_index("ix", C.REFRESH_MODE_INCREMENTAL)
+        session.index_manager.clear_cache()
+        df2 = session.read.parquet(src)
+        got = q(df2).collect()
+        assert got.num_rows == before + 5
+        session.conf.set(C.SERVE_CACHE_ENABLED, False)
+
+
+class TestCachedJoinDifferential:
+    def _join(self, session, df_o, df_i):
+        j = df_o.join(df_i, on=df_o["ok"] == df_i["k"])
+        return j.select("ok", "v", "q")
+
+    def _mk(self, session, hs, tmp_path):
+        src = _lineitem(tmp_path)
+        o = tmp_path / "orders"
+        o.mkdir()
+        rng = np.random.default_rng(5)
+        for i in range(2):
+            pq.write_table(
+                pa.table(
+                    {
+                        "ok": np.arange(i * 250, (i + 1) * 250, dtype=np.int64),
+                        "v": rng.normal(0, 1, 250),
+                    }
+                ),
+                str(o / f"p{i}.parquet"),
+            )
+        df_i = session.read.parquet(src)
+        df_o = session.read.parquet(str(o))
+        hs.create_index(df_i, CoveringIndexConfig("ix_i", ["k"], ["q"]))
+        hs.create_index(df_o, CoveringIndexConfig("ix_o", ["ok"], ["v"]))
+        return df_o, df_i, src
+
+    def test_join_cached_equals_uncached(self, session, hs, tmp_path):
+        df_o, df_i, _src = self._mk(session, hs, tmp_path)
+        session.enable_hyperspace()
+        plan = self._join(session, df_o, df_i).explain()
+        assert plan.count("Hyperspace(Type: CI") == 2
+        expected = sorted_table(self._join(session, df_o, df_i).collect())
+        session.conf.set(C.SERVE_CACHE_ENABLED, True)
+        for _ in range(2):
+            got = sorted_table(self._join(session, df_o, df_i).collect())
+            assert got.equals(expected)
+        assert session.serve_cache.hits > 0
+        session.conf.set(C.SERVE_CACHE_ENABLED, False)
+
+    def test_hybrid_scan_after_cache_populated(self, session, hs, tmp_path):
+        df_o, df_i, src = self._mk(session, hs, tmp_path)
+        session.conf.set(C.SERVE_CACHE_ENABLED, True)
+        session.enable_hyperspace()
+        first = sorted_table(self._join(session, df_o, df_i).collect())
+        assert sorted_table(self._join(session, df_o, df_i).collect()).equals(
+            first
+        )
+        # append ~ a few source rows AFTER the cache is warm
+        extra = pa.table(
+            {
+                "k": pa.array([3, 3, 490], type=pa.int64()),
+                "d": pa.array(np.full(3, np.datetime64("1998-01-01"), dtype="datetime64[D]")),
+                "q": pa.array([9, 9, 9], type=pa.int64()),
+                "p": pa.array([1.0] * 3),
+                "s": pa.array(["sX"] * 3),
+            }
+        )
+        pq.write_table(extra, os.path.join(src, "appended.parquet"))
+        session.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, True)
+        session.index_manager.clear_cache()
+        df_i2 = session.read.parquet(src)
+        hybrid = sorted_table(self._join(session, df_o, df_i2).collect())
+        session.disable_hyperspace()
+        raw = sorted_table(self._join(session, df_o, df_i2).collect())
+        assert hybrid.equals(raw)
+        assert hybrid.num_rows == first.num_rows + 3
+        session.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, False)
+        session.conf.set(C.SERVE_CACHE_ENABLED, False)
+
+
+class TestPreparedJoinSide:
+    def _bs(self, data):
+        return {
+            b: ColumnarBatch.from_arrow(pa.table(t)) for b, t in data.items()
+        }
+
+    def test_subset_and_mismatched_buckets(self):
+        from hyperspace_tpu.execution.join_exec import co_bucketed_join
+
+        lbs = self._bs(
+            {
+                0: {"k": pa.array([1, 2], type=pa.int64())},
+                1: {"k": pa.array([5], type=pa.int64())},
+            }
+        )
+        rbs = self._bs(
+            {
+                1: {"rk": pa.array([5, 5], type=pa.int64())},
+                2: {"rk": pa.array([9], type=pa.int64())},
+            }
+        )
+        out = co_bucketed_join(lbs, rbs, [("k", "rk")])
+        assert out.num_rows == 2
+        assert out.column("k").values.tolist() == [5, 5]
+
+    def test_null_keys_never_match(self):
+        from hyperspace_tpu.execution.join_exec import co_bucketed_join
+
+        lbs = self._bs({0: {"k": pa.array([1, None, 3], type=pa.int64())}})
+        rbs = self._bs({0: {"rk": pa.array([None, 3], type=pa.int64())}})
+        out = co_bucketed_join(lbs, rbs, [("k", "rk")])
+        assert out.column("k").values.tolist() == [3]
+
+    def test_multi_key_verified(self):
+        from hyperspace_tpu.execution.join_exec import co_bucketed_join
+
+        lbs = self._bs(
+            {
+                0: {
+                    "a": pa.array([1, 1, 2], type=pa.int64()),
+                    "b": pa.array([10, 11, 10], type=pa.int64()),
+                }
+            }
+        )
+        rbs = self._bs(
+            {
+                0: {
+                    "ra": pa.array([1, 2], type=pa.int64()),
+                    "rb": pa.array([11, 10], type=pa.int64()),
+                }
+            }
+        )
+        out = co_bucketed_join(lbs, rbs, [("a", "ra"), ("b", "rb")])
+        got = sorted(
+            zip(
+                out.column("a").values.tolist(),
+                out.column("b").values.tolist(),
+            )
+        )
+        assert got == [(1, 11), (2, 10)]
+
+    def test_empty_side(self):
+        from hyperspace_tpu.execution.join_exec import co_bucketed_join
+
+        lbs = self._bs({0: {"k": pa.array([1], type=pa.int64())}})
+        assert co_bucketed_join(lbs, {}, [("k", "rk")]) is None
