@@ -177,11 +177,14 @@ def _serve_cache(session):
 
 
 def _cacheable_scan(rel) -> bool:
-    """Only clean parquet-family scans are cached: no row-level delete
-    compensation, no injected partition constants (both are query-shaped
-    state that must not leak between queries)."""
+    """Only clean INDEX scans are cached (index data files are immutable
+    and bounded; pinning arbitrary source tables in RAM is not this
+    feature): no row-level delete compensation, no injected partition
+    constants (both are query-shaped state that must not leak between
+    queries)."""
     return (
-        rel.fmt in ("parquet", "delta", "iceberg")
+        rel.index_info is not None
+        and rel.fmt in ("parquet", "delta", "iceberg")
         and rel.excluded_file_ids is None
         and not rel.file_partition_values
         and bool(rel.files)
@@ -195,7 +198,7 @@ def _cached_filter(
     path not applicable; caller runs the normal read).
 
     On a cached key-sorted index bucket a pinned-key conjunct narrows the
-    candidate rows by binary search (``SortedSegmentState``) before the
+    candidate rows by binary search (``ScanCacheEntry``) before the
     full mask runs — the RAM-resident analogue of the parquet row-group
     pruning the cold path gets from ``_pushdown_filters``, but without
     re-reading anything.
@@ -205,7 +208,7 @@ def _cached_filter(
     if cache is None or not _cacheable_scan(rel):
         return None
     from hyperspace_tpu.execution.serve_cache import (
-        SortedSegmentState,
+        ScanCacheEntry,
         file_fingerprint,
     )
 
@@ -215,20 +218,27 @@ def _cached_filter(
     cols = tuple(c for c in rel.column_names if c in child_needed) or (
         rel.column_names[0],
     )
-    key = ("scan", fp, cols)
+    # one entry per file set; columns accrue on demand so overlapping
+    # projections share a single decoded copy per column
+    key = ("scan", fp)
     state = cache.get(key)
     if state is None:
         counts = pio.file_row_counts(list(rel.files))
-        table = pio.read_table(list(rel.files), list(cols), rel.fmt)
-        batch = ColumnarBatch.from_arrow(table)
         segs = []
         pos = 0
         for c in counts:
             segs.append((pos, pos + c))
             pos += c
-        state = SortedSegmentState(batch, segs)
-        cache.put(key, state, state.nbytes)
-    batch = state.batch
+        state = ScanCacheEntry(segs)
+    missing = [c for c in cols if c not in state.columns]
+    if missing:
+        table = pio.read_table(list(rel.files), missing, rel.fmt)
+        from hyperspace_tpu.io.columnar import Column
+
+        for c in missing:
+            state.add_column(c, Column.from_arrow(table.column(c)))
+        cache.put(key, state, state.budget_nbytes)
+    batch = state.batch_for(cols)
     idx = _sorted_narrow(state, cond, rel)
     if idx is not None:
         sub = batch.take(idx)
@@ -297,7 +307,7 @@ def _sorted_narrow(state, cond: E.Expr, rel) -> Optional[np.ndarray]:
                 continue
         else:
             continue
-        if col not in state.batch.columns:
+        if col not in state.columns:
             continue
         krep, sorted_ok = state.column_state(col)
         if not sorted_ok:
@@ -420,7 +430,10 @@ def _prepared_join_side(
                 hit = cache.get(key)
                 if hit is not None:
                     return hit
-    bs = _exec_bucketed(plan, needed, session, bucket_cols)
+    # when a joinside entry will be cached, don't ALSO cache the raw
+    # bucketed batches — the prepared side contains the same decoded data
+    # (a second full copy would halve effective cache capacity)
+    bs = _exec_bucketed(plan, needed, session, bucket_cols, cache_scan=key is None)
     if not bs:
         return None
     prep = prepare_join_side(bs, key_cols)
@@ -645,7 +658,8 @@ def _aligned_bucket_layouts(plan: Join, on):
 
 
 def _exec_bucketed(
-    plan: LogicalPlan, needed: Set[str], session, bucket_cols
+    plan: LogicalPlan, needed: Set[str], session, bucket_cols,
+    cache_scan: bool = True,
 ):
     """Execute a linear subtree into per-bucket batches.
 
@@ -681,7 +695,7 @@ def _exec_bucketed(
             )
             cache = _serve_cache(session)
             key = None
-            if cache is not None and _cacheable_scan(rel):
+            if cache_scan and cache is not None and _cacheable_scan(rel):
                 from hyperspace_tpu.execution.serve_cache import (
                     file_fingerprint,
                 )
@@ -725,7 +739,7 @@ def _exec_bucketed(
         child_needed = set(needed) | E.references(plan.condition)
         out = {}
         for b, batch in _exec_bucketed(
-            plan.child, child_needed, session, bucket_cols
+            plan.child, child_needed, session, bucket_cols, cache_scan
         ).items():
             out[b] = batch.filter(_filter_mask(plan.condition, batch, session))
         return out
@@ -734,7 +748,7 @@ def _exec_bucketed(
         return {
             b: batch.select([c for c in cols if c in batch.column_names])
             for b, batch in _exec_bucketed(
-                plan.child, set(cols), session, bucket_cols
+                plan.child, set(cols), session, bucket_cols, cache_scan
             ).items()
         }
     if isinstance(plan, Union):
@@ -743,7 +757,7 @@ def _exec_bucketed(
         left = {
             b: batch.select(read_cols)
             for b, batch in _exec_bucketed(
-                plan.left, set(read_cols), session, bucket_cols
+                plan.left, set(read_cols), session, bucket_cols, cache_scan
             ).items()
         }
         spec = _bucket_layout(plan.left)

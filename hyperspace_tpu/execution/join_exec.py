@@ -205,8 +205,10 @@ def prepare_join_side(
         ge = combined[1:] >= combined[:-1]
         # bucket boundaries need not be ordered relative to each other;
         # offs[i] == 0 means every earlier bucket is empty (no boundary)
+        # and offs[i] == n means this and all later buckets are empty
+        # (boundary index n-1 would run past the length-(n-1) ge array)
         starts = offs[1:]
-        cross_idx = starts[starts > 0] - 1
+        cross_idx = starts[(starts > 0) & (starts < n)] - 1
         if len(cross_idx):
             ge = ge.copy()
             ge[cross_idx] = True

@@ -111,29 +111,47 @@ class ServeCache:
         return len(self._entries)
 
 
-class SortedSegmentState:
-    """Lazily-computed sorted-segment view of one cached scan column.
+class ScanCacheEntry:
+    """Per-COLUMN cached decode of one index scan, with lazily-computed
+    sorted-segment state.
 
-    Index bucket files are key-sorted on disk; after an incremental
-    refresh a bucket holds several files, each sorted but not globally
-    merged. The cached batch keeps the per-file segment boundaries and,
-    per column, whether every segment is monotonic in key-rep order —
+    One entry per file set (key = ("scan", fp)); columns are added on
+    demand as queries need them, so overlapping projections share one
+    decoded copy per column instead of pinning a full batch per distinct
+    column set. Index bucket files are key-sorted on disk; after an
+    incremental refresh a bucket holds several files, each sorted but not
+    globally merged — the entry keeps per-file segment boundaries and,
+    per column, whether every segment is monotonic in key-rep order,
     detected from the data (never trusted from metadata), the same
     doctrine as the join's presorted fast path."""
 
-    def __init__(self, batch: ColumnarBatch, segments):
-        self.batch = batch
+    def __init__(self, segments):
         self.segments = tuple(segments)  # ((start, end), ...)
-        self._cols: dict = {}
+        self.columns: dict = {}  # name -> Column
+        self._reps: dict = {}  # name -> (key_rep, all_segments_sorted)
+
+    @property
+    def num_rows(self) -> int:
+        return self.segments[-1][1] if self.segments else 0
+
+    def batch_for(self, cols) -> Optional[ColumnarBatch]:
+        """A batch over ``cols``, or None when some column is not cached
+        yet (caller reads the missing ones and ``add_column``s them)."""
+        if any(c not in self.columns for c in cols):
+            return None
+        return ColumnarBatch({c: self.columns[c] for c in cols})
+
+    def add_column(self, name: str, col) -> None:
+        self.columns[name] = col
 
     def column_state(self, name: str):
         """(key_rep, all_segments_sorted) for a column, memoized."""
         import numpy as np
 
-        st = self._cols.get(name)
+        st = self._reps.get(name)
         if st is not None:
             return st
-        rep = self.batch.column(name).key_rep()
+        rep = self.columns[name].key_rep()
         ok = True
         for s, e in self.segments:
             seg = rep[s:e]
@@ -141,9 +159,23 @@ class SortedSegmentState:
                 ok = False
                 break
         st = (rep, ok)
-        self._cols[name] = st
+        self._reps[name] = st
         return st
 
     @property
-    def nbytes(self) -> int:
-        return batch_nbytes(self.batch)
+    def budget_nbytes(self) -> int:
+        """What the LRU accounting charges: every cached column PLUS its
+        worst-case memoized key-rep (8 bytes/row, ``column_state``) —
+        sizes are fixed at put() time, so growth must be pre-charged or
+        the byte cap stops bounding real memory. Re-put after
+        ``add_column`` to refresh the charge."""
+        total = 0
+        rows = self.num_rows
+        for c in self.columns.values():
+            for a in (c.values, c.codes, c.validity):
+                if a is not None:
+                    total += a.nbytes
+            if c.dictionary:
+                total += sum(len(s) + 49 for s in c.dictionary)
+            total += 8 * rows
+        return total

@@ -128,39 +128,6 @@ def _match_ranges_host(l_rep, l_len, r_rep, r_len):
     return perm_l, perm_r, lo, cnt
 
 
-def rows_monotonic(a: np.ndarray) -> bool:
-    """True when every row of [B, n] is non-decreasing. Comparison-based —
-    np.diff on int64 WRAPS on overflow (a real hazard: splitmix-combined
-    keys are uniform over the full int64 range, and a negative key before
-    the +max pad also wraps), so subtraction must never be used here."""
-    return bool(np.all(a[:, 1:] >= a[:, :-1]))
-
-
-def presorted_match_ranges(
-    l_pad: np.ndarray,
-    l_len: np.ndarray,
-    r_pad: np.ndarray,
-    r_len: np.ndarray,
-) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-    """Match ranges for ALREADY-SORTED padded buckets: binary search only,
-    identity permutations. Shares the pad/validity contract of
-    ``_bucket_join``/``_match_ranges_host`` (pads are +max at the tail; a
-    real key equal to the pad value is protected by clipping hi to r_len
-    and masking cnt by l_len)."""
-    B, n = l_pad.shape
-    lo = np.empty((B, n), dtype=np.int64)
-    hi = np.empty((B, n), dtype=np.int64)
-    for b in range(B):
-        lo[b] = np.searchsorted(r_pad[b], l_pad[b], side="left")
-        hi[b] = np.searchsorted(r_pad[b], l_pad[b], side="right")
-    hi = np.minimum(hi, r_len[:, None])
-    col = np.arange(n)[None, :]
-    cnt = np.where(col < l_len[:, None], np.maximum(hi - lo, 0), 0)
-    perm_l = np.broadcast_to(np.arange(n), (B, n))
-    perm_r = np.broadcast_to(np.arange(r_pad.shape[1]), (B, r_pad.shape[1]))
-    return perm_l, perm_r, lo, cnt
-
-
 def bucketed_match_ranges(
     mesh,
     l_rep: np.ndarray,

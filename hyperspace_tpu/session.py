@@ -123,6 +123,7 @@ class HyperspaceSession:
         self._source_manager = None
         self._index_manager = None
         self._serve_cache = None
+        self._serve_cache_lock = threading.Lock()
         self._catalog: dict = {}
 
     # -- context (HyperspaceContext, Hyperspace.scala:195-223) --------------
@@ -151,11 +152,15 @@ class HyperspaceSession:
         if not self.conf.serve_cache_enabled:
             return None
         max_bytes = self.conf.serve_cache_max_bytes
-        if self._serve_cache is None or self._serve_cache.max_bytes != max_bytes:
-            from hyperspace_tpu.execution.serve_cache import ServeCache
+        with self._serve_cache_lock:
+            if (
+                self._serve_cache is None
+                or self._serve_cache.max_bytes != max_bytes
+            ):
+                from hyperspace_tpu.execution.serve_cache import ServeCache
 
-            self._serve_cache = ServeCache(max_bytes)
-        return self._serve_cache
+                self._serve_cache = ServeCache(max_bytes)
+            return self._serve_cache
 
     def clear_serve_cache(self) -> None:
         if self._serve_cache is not None:

@@ -1,0 +1,103 @@
+"""Quick serve-path timings on the real chip: filter + join, cached vs
+uncached vs unindexed. Throwaway diagnostic."""
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from bench import gen_data, log, timeit
+
+
+def p50(fn, reps):
+    return timeit(fn, reps)["p50"]
+
+
+def main():
+    n_items = int(os.environ.get("HS_BENCH_ROWS", 4_000_000))
+    n_orders = max(n_items // 8, 1)
+    reps = 5
+
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.hyperspace import Hyperspace
+    from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+    from hyperspace_tpu.session import HyperspaceSession
+
+    tmp = tempfile.mkdtemp(prefix="hs_serve_")
+    try:
+        items_dir, orders_dir = gen_data(tmp, n_items, n_orders)
+        session = HyperspaceSession()
+        session.conf.set(C.INDEX_SYSTEM_PATH, os.path.join(tmp, "indexes"))
+        session.conf.set(C.INDEX_NUM_BUCKETS, 8)
+        hs = Hyperspace(session)
+        items = session.read.parquet(items_dir)
+        orders = session.read.parquet(orders_dir)
+        hs.create_index(
+            items,
+            CoveringIndexConfig(
+                "l_idx",
+                ["l_orderkey"],
+                ["l_shipdate", "l_quantity", "l_extendedprice"],
+            ),
+        )
+        hs.create_index(
+            orders,
+            CoveringIndexConfig("o_idx", ["o_orderkey"], ["o_custkey", "o_totalprice"]),
+        )
+        session.conf.set(C.INDEX_FILTER_RULE_USE_BUCKET_SPEC, True)
+        key = int(n_orders // 3)
+
+        def q_filter(df):
+            return df.filter(df["l_orderkey"] == key).select(
+                "l_orderkey", "l_shipdate", "l_quantity"
+            )
+
+        def q_join(o, i):
+            return o.join(i, on=o["o_orderkey"] == i["l_orderkey"]).select(
+                "o_orderkey", "o_custkey", "l_quantity"
+            )
+
+        session.enable_hyperspace()
+        # uncached
+        q_filter(items).collect()
+        f_un = p50(lambda: q_filter(items).collect(), reps)
+        q_join(orders, items).collect()
+        j_un = p50(lambda: q_join(orders, items).collect(), reps)
+        # cached
+        session.conf.set(C.SERVE_CACHE_ENABLED, True)
+        t0 = time.perf_counter()
+        q_filter(items).collect()
+        f_warmup = time.perf_counter() - t0
+        f_ca = p50(lambda: q_filter(items).collect(), reps)
+        t0 = time.perf_counter()
+        q_join(orders, items).collect()
+        j_warmup = time.perf_counter() - t0
+        j_ca = p50(lambda: q_join(orders, items).collect(), reps)
+        log(f"cache stats: {session.serve_cache.hits} hits, "
+            f"{session.serve_cache.misses} misses, "
+            f"{session.serve_cache.resident_bytes/1e6:.0f}MB resident")
+        session.conf.set(C.SERVE_CACHE_ENABLED, False)
+        session.disable_hyperspace()
+        q_filter(items).collect()
+        f_raw = p50(lambda: q_filter(items).collect(), reps)
+        q_join(orders, items).collect()
+        j_raw = p50(lambda: q_join(orders, items).collect(), reps)
+        log(
+            f"filter: unindexed {f_raw*1e3:.1f}ms | indexed {f_un*1e3:.1f}ms "
+            f"({f_raw/f_un:.1f}x) | cached {f_ca*1e3:.2f}ms ({f_raw/f_ca:.1f}x, "
+            f"cold-vs-cached {f_un/f_ca:.1f}x, warmup {f_warmup*1e3:.0f}ms)"
+        )
+        log(
+            f"join:   unindexed {j_raw*1e3:.1f}ms | indexed {j_un*1e3:.1f}ms "
+            f"({j_raw/j_un:.2f}x) | cached {j_ca*1e3:.1f}ms ({j_raw/j_ca:.2f}x, "
+            f"cold-vs-cached {j_un/j_ca:.2f}x, warmup {j_warmup*1e3:.0f}ms)"
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

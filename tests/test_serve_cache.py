@@ -16,8 +16,8 @@ import pytest
 
 from hyperspace_tpu import constants as C
 from hyperspace_tpu.execution.serve_cache import (
+    ScanCacheEntry,
     ServeCache,
-    SortedSegmentState,
     batch_nbytes,
     file_fingerprint,
 )
@@ -83,31 +83,45 @@ class TestFingerprint:
         assert file_fingerprint([str(tmp_path / "nope")]) is None
 
 
-class TestSortedSegmentState:
-    def _batch(self, values):
-        return ColumnarBatch.from_arrow(
+class TestScanCacheEntry:
+    def _entry(self, values, segments):
+        batch = ColumnarBatch.from_arrow(
             pa.table({"k": pa.array(values, type=pa.int64())})
         )
+        st = ScanCacheEntry(segments)
+        st.add_column("k", batch.column("k"))
+        return st
 
     def test_sorted_segments_detected(self):
-        st = SortedSegmentState(self._batch([1, 5, 9, 2, 3]), [(0, 3), (3, 5)])
+        st = self._entry([1, 5, 9, 2, 3], [(0, 3), (3, 5)])
         rep, ok = st.column_state("k")
         assert ok
         assert rep.tolist() == [1, 5, 9, 2, 3]
 
     def test_unsorted_segment_detected(self):
-        st = SortedSegmentState(self._batch([1, 5, 3]), [(0, 3)])
+        st = self._entry([1, 5, 3], [(0, 3)])
         _, ok = st.column_state("k")
         assert not ok
 
     def test_memoized(self):
-        st = SortedSegmentState(self._batch([1, 2]), [(0, 2)])
+        st = self._entry([1, 2], [(0, 2)])
         assert st.column_state("k") is st.column_state("k")
 
-    def test_nbytes_positive(self):
-        st = SortedSegmentState(self._batch([1, 2]), [(0, 2)])
-        assert st.nbytes > 0
-        assert batch_nbytes(st.batch) == st.nbytes
+    def test_columns_accrue_and_budget_grows(self):
+        st = self._entry([1, 2], [(0, 2)])
+        assert st.batch_for(["k", "v"]) is None  # v not cached yet
+        b1 = st.budget_nbytes
+        v = ColumnarBatch.from_arrow(
+            pa.table({"v": pa.array([1.0, 2.0])})
+        ).column("v")
+        st.add_column("v", v)
+        assert st.batch_for(["k", "v"]).num_rows == 2
+        assert st.budget_nbytes > b1  # re-charged for the new column
+
+    def test_budget_charges_rep_memo(self):
+        st = self._entry([1, 2], [(0, 2)])
+        # budget = column bytes + 8 bytes/row pre-charge for the key-rep
+        assert st.budget_nbytes == 2 * 8 + 2 * 8
 
 
 @pytest.fixture
@@ -343,3 +357,40 @@ class TestPreparedJoinSide:
 
         lbs = self._bs({0: {"k": pa.array([1], type=pa.int64())}})
         assert co_bucketed_join(lbs, {}, [("k", "rk")]) is None
+
+    def test_trailing_empty_bucket(self):
+        # regression: offs[-1] == n (empty last bucket, e.g. a selective
+        # filter emptied it) must not index past the sortedness array
+        from hyperspace_tpu.execution.join_exec import prepare_join_side
+
+        empty = ColumnarBatch.from_arrow(
+            pa.table({"k": pa.array([], type=pa.int64())})
+        )
+        lbs = self._bs({0: {"k": pa.array([1, 2, 3], type=pa.int64())}})
+        lbs[1] = empty
+        prep = prepare_join_side(lbs, ["k"])
+        assert prep.sorted_buckets
+        assert prep.sizes.tolist() == [3, 0]
+
+    def test_empty_middle_bucket_join(self):
+        from hyperspace_tpu.execution.join_exec import co_bucketed_join
+
+        empty = ColumnarBatch.from_arrow(
+            pa.table({"k": pa.array([], type=pa.int64())})
+        )
+        lbs = self._bs(
+            {
+                0: {"k": pa.array([7, 8], type=pa.int64())},
+                2: {"k": pa.array([9], type=pa.int64())},
+            }
+        )
+        lbs[1] = empty
+        rbs = self._bs(
+            {
+                0: {"rk": pa.array([8], type=pa.int64())},
+                1: {"rk": pa.array([], type=pa.int64())},
+                2: {"rk": pa.array([9, 9], type=pa.int64())},
+            }
+        )
+        out = co_bucketed_join(lbs, rbs, [("k", "rk")])
+        assert sorted(out.column("k").values.tolist()) == [8, 9, 9]
