@@ -56,15 +56,25 @@ class CreateAction(Action):
                 f"Relation is not supported by any source provider: "
                 f"{leaves[0].relation.root_paths}"
             )
-        if (
-            resolver.resolve(
-                self.index_config.referenced_columns, self.df.columns
-            )
-            is None
-        ):
+        resolved = resolver.resolve(
+            self.index_config.referenced_columns,
+            self.df.columns,
+            nested_available=resolver.nested_available_from(self.df.columns),
+        )
+        if resolved is None:
             raise HyperspaceException(
                 f"Index columns {self.index_config.referenced_columns} could "
                 f"not be resolved against {self.df.columns}"
+            )
+        # nested-field gate (CreateAction.scala:69-71): struct paths index
+        # only when hyperspace.index.supportNestedFields is on
+        if not self.session.conf.support_nested_fields and any(
+            rc.normalized_name.startswith(C.NESTED_FIELD_PREFIX)
+            for rc in resolved
+        ):
+            raise HyperspaceException(
+                "Indexing nested (struct) fields requires "
+                f"{C.INDEX_SUPPORT_NESTED_FIELDS}=true"
             )
         latest = self.log_manager.get_latest_log()
         if latest is not None and latest.state != States.DOESNOTEXIST:

@@ -151,6 +151,13 @@ class Config:
         )
 
     @property
+    def support_nested_fields(self) -> bool:
+        return self.get_bool(
+            C.INDEX_SUPPORT_NESTED_FIELDS,
+            C.INDEX_SUPPORT_NESTED_FIELDS_DEFAULT,
+        )
+
+    @property
     def serve_cache_enabled(self) -> bool:
         return self.get_bool(
             C.SERVE_CACHE_ENABLED, C.SERVE_CACHE_ENABLED_DEFAULT

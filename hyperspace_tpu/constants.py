@@ -154,6 +154,12 @@ DELTA_VERSION_HISTORY_PROPERTY = "deltaVersions"
 # Nested-column prefix (util/ResolverUtils.scala `__hs_nested.`)
 NESTED_FIELD_PREFIX = "__hs_nested."
 
+# Nested (struct) field indexing is opt-in, as in the reference
+# (conf.supportNestedFields gate, actions/CreateAction.scala:69-71;
+# flattened-name machinery in util/ResolverUtils.scala:130-234).
+INDEX_SUPPORT_NESTED_FIELDS = "hyperspace.index.supportNestedFields"
+INDEX_SUPPORT_NESTED_FIELDS_DEFAULT = False
+
 # Filenames written by the index data plane.
 INDEX_FILE_PREFIX = "part"
 

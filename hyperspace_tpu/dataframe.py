@@ -30,6 +30,25 @@ from hyperspace_tpu.plan.nodes import (
 )
 
 
+def _resolve_plan_name(plan: LogicalPlan, name: str) -> str:
+    """Map a user-facing name to a plan column. A dotted struct path
+    (``nested.leaf.cnt``) resolves to its flattened
+    ``__hs_nested.``-prefixed column when present — the query-surface side
+    of the reference's nested-field support
+    (``util/ResolverUtils.scala:130-234``); a literal column of the same
+    dotted name always wins."""
+    if name in plan.output:
+        return name
+    from hyperspace_tpu.constants import NESTED_FIELD_PREFIX
+
+    prefixed = NESTED_FIELD_PREFIX + name
+    if prefixed in plan.output:
+        return prefixed
+    raise HyperspaceException(
+        f"No such column {name!r}; available: {plan.output}"
+    )
+
+
 class DataFrame:
     def __init__(self, session, plan: LogicalPlan):
         self._session = session
@@ -47,12 +66,11 @@ class DataFrame:
     def logical_plan(self) -> LogicalPlan:
         return self._plan
 
+    def _resolve_name(self, name: str) -> str:
+        return _resolve_plan_name(self._plan, name)
+
     def __getitem__(self, name: str) -> E.Col:
-        if name not in self._plan.output:
-            raise HyperspaceException(
-                f"No such column {name!r}; available: {self._plan.output}"
-            )
-        return E.Col(name)
+        return E.Col(self._resolve_name(name))
 
     # -- transformations ----------------------------------------------------
     def filter(self, condition: E.Expr) -> "DataFrame":
@@ -68,6 +86,7 @@ class DataFrame:
             if len(columns) == 1 and isinstance(columns[0], (list, tuple))
             else columns
         )
+        cols = [self._resolve_name(c) for c in cols]
         return DataFrame(self._session, Project(cols, self._plan))
 
     def join(
@@ -89,6 +108,7 @@ class DataFrame:
             if len(columns) == 1 and isinstance(columns[0], (list, tuple))
             else columns
         )
+        cols = [self._resolve_name(c) for c in cols]
         return GroupedData(self._session, self._plan, cols)
 
     groupBy = group_by
@@ -116,9 +136,9 @@ class DataFrame:
         resolved = []
         for k, a in zip(names, asc):
             if isinstance(k, tuple):
-                resolved.append((k[0], bool(k[1])))
+                resolved.append((self._resolve_name(k[0]), bool(k[1])))
             else:
-                resolved.append((k, a))
+                resolved.append((self._resolve_name(k), a))
         return DataFrame(self._session, Sort(resolved, self._plan))
 
     order_by = sort
@@ -174,6 +194,16 @@ class GroupedData:
                     f"agg() takes AggSpec values (hyperspace_tpu.functions); "
                     f"got {s!r}"
                 )
+        import dataclasses
+
+        specs = [
+            s
+            if s.column is None
+            else dataclasses.replace(
+                s, column=_resolve_plan_name(self._plan, s.column)
+            )
+            for s in specs
+        ]
         return DataFrame(
             self._session, Aggregate(self._group_by, specs, self._plan)
         )

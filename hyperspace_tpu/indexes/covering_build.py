@@ -175,13 +175,18 @@ def resolve_index_schema(rel, config, properties: Dict[str, str]):
     begin-phase and final log entries can never diverge."""
     import json
 
+    nested = resolver.nested_available_from(rel.column_names)
     indexed = [
-        rc.name
-        for rc in resolver.require_resolve(config.indexed_columns, rel.column_names)
+        rc.normalized_name
+        for rc in resolver.require_resolve(
+            config.indexed_columns, rel.column_names, nested_available=nested
+        )
     ]
     included = [
-        rc.name
-        for rc in resolver.require_resolve(config.included_columns, rel.column_names)
+        rc.normalized_name
+        for rc in resolver.require_resolve(
+            config.included_columns, rel.column_names, nested_available=nested
+        )
     ]
     lineage = str(properties.get(LINEAGE_PROPERTY, "false")).lower() == "true"
     schema = rel.schema

@@ -50,6 +50,44 @@ def _is_string(t: pa.DataType) -> bool:
     return pa.types.is_string(t) or pa.types.is_large_string(t)
 
 
+def flatten_schema_fields(fields):
+    """Replace struct-typed fields by their scalar leaf paths as flat
+    ``__hs_nested.<path>`` columns (depth-first).
+
+    The engine's data plane is SoA over fixed-width/dictionary columns —
+    struct trees cannot live on device. The reference solves the same
+    problem by indexing nested fields as prefix-flattened columns
+    (``util/ResolverUtils.scala:130-234``); here the flattening happens at
+    relation construction, so nested leaves are first-class columns
+    everywhere (planner, rules, executor) and the struct root disappears.
+    Non-scalar leaves (lists, maps) are dropped — same indexing
+    restriction as the reference."""
+    from hyperspace_tpu.constants import NESTED_FIELD_PREFIX
+
+    def leaves(path, t):
+        for i in range(t.num_fields):
+            f = t.field(i)
+            if "." in f.name:
+                # a dot inside a field name cannot round-trip through the
+                # dotted flattened name (the read path re-splits on ".");
+                # drop it like other unindexable leaves
+                continue
+            if pa.types.is_struct(f.type):
+                yield from leaves(path + "." + f.name, f.type)
+            elif not pa.types.is_nested(f.type):
+                # is_nested covers list/large_list/fixed_size_list/
+                # list_view/map/union — none of them are scalar leaves
+                yield (NESTED_FIELD_PREFIX + path + "." + f.name, f.type)
+
+    out = []
+    for name, t in fields:
+        if pa.types.is_struct(t) and "." not in name:
+            out.extend(leaves(name, t))
+        else:
+            out.append((name, t))
+    return tuple(out)
+
+
 @dataclasses.dataclass
 class Column:
     """One column of a :class:`ColumnarBatch`.

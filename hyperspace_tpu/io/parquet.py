@@ -12,6 +12,7 @@ from file names at query time (the reference relies on
 
 from __future__ import annotations
 
+import functools as _functools
 import os
 import re
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
@@ -50,6 +51,50 @@ def file_row_counts(paths: Sequence[str]) -> List[int]:
     )
 
 
+def _literal_column_names(path: str) -> frozenset:
+    """Top-level column names of one parquet file, memoized by the file's
+    identity (path, size, mtime_ns) — per-file read loops with nested
+    columns would otherwise re-parse the same immutable footer per call."""
+    st = os.stat(path)
+    return _literal_column_names_cached(path, st.st_size, st.st_mtime_ns)
+
+
+@_functools.lru_cache(maxsize=4096)
+def _literal_column_names_cached(path, _size, _mtime_ns) -> frozenset:
+    return frozenset(pq.read_schema(path).names)
+
+
+def _resolve_nested_columns(paths, columns, fmt):
+    """Split requested columns into (physical read list, extraction plan).
+
+    A ``__hs_nested.``-prefixed column is VIRTUAL when the file does not
+    carry it as a literal flat column (source tables store the struct;
+    index data files store the literal flattened column — reference
+    ``util/ResolverUtils.scala:130-234``): the struct ROOT is read instead
+    and the leaf extracted post-read. Returns (read_cols, extract) where
+    extract maps output name -> (root, leaf_path); extract is empty when
+    nothing is virtual."""
+    from hyperspace_tpu.constants import NESTED_FIELD_PREFIX
+
+    prefixed = [c for c in columns if c.startswith(NESTED_FIELD_PREFIX)]
+    if not prefixed:
+        return list(columns), {}
+    virtual = prefixed
+    if fmt in ("parquet", "delta", "iceberg"):
+        literal = _literal_column_names(paths[0])
+        virtual = [c for c in prefixed if c not in literal]
+    if not virtual:
+        return list(columns), {}
+    extract = {}
+    read_cols = [c for c in columns if c not in virtual]
+    for c in virtual:
+        parts = c[len(NESTED_FIELD_PREFIX):].split(".")
+        extract[c] = (parts[0], parts[1:])
+        if parts[0] not in read_cols:
+            read_cols.append(parts[0])
+    return read_cols, extract
+
+
 def read_table(
     paths: Sequence[str],
     columns: Optional[Sequence[str]] = None,
@@ -60,7 +105,29 @@ def read_table(
     ``paths`` order, file by file). ``filters`` (parquet-like formats
     only) is a pyarrow DNF conjunction used for ROW-GROUP pruning — the
     executor re-applies its own mask afterwards, so filters only need to
-    keep a superset of matching rows."""
+    keep a superset of matching rows. ``__hs_nested.``-prefixed columns
+    that are not literal flat columns in the files are served by reading
+    the struct root and extracting the leaf (``_resolve_nested_columns``)."""
+    if columns:
+        read_cols, extract = _resolve_nested_columns(paths, columns, fmt)
+        if extract:
+            import pyarrow.compute as pc
+
+            if filters:
+                # a filter on a virtual column has no physical column to
+                # act on; dropping conjuncts is superset-safe by contract
+                filters = [
+                    f for f in filters if f[0] not in extract
+                ] or None
+            t = read_table(paths, read_cols, fmt, filters)
+            out = {}
+            for c in columns:
+                if c in extract:
+                    root, leaf_path = extract[c]
+                    out[c] = pc.struct_field(t.column(root), leaf_path)
+                else:
+                    out[c] = t.column(c)
+            return pa.table(out)
     if fmt in ("parquet", "delta", "iceberg") and len(paths) > 1:
         # One threaded dataset read beats N sequential reads ~3x and pyarrow
         # preserves the given file order — but it locks the first file's

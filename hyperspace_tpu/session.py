@@ -47,6 +47,11 @@ class DataFrameReader:
         else:
             head = read_table(files[:1], None, fmt)
             fields = tuple((n, head.schema.field(n).type) for n in head.column_names)
+        # struct columns surface as flat __hs_nested.<path> leaf columns
+        # (the engine's data plane is SoA; see io/columnar.py)
+        from hyperspace_tpu.io.columnar import flatten_schema_fields
+
+        fields = flatten_schema_fields(fields)
         # glob patterns stay patterns in root_paths (re-expanded on every
         # refresh/signature listing) — but absolutized like plain paths,
         # or re-expansion would depend on the process cwd
@@ -86,11 +91,13 @@ class DataFrameReader:
         options = [("deltaVersion", str(snap.version))]
         if version_as_of is not None:
             options.append(("versionAsOf", str(version_as_of)))
+        from hyperspace_tpu.io.columnar import flatten_schema_fields
+
         rel = Relation(
             root_paths=(os.path.abspath(path),),
             files=tuple(snap.file_paths),
             fmt="delta",
-            schema_fields=tuple(snap.schema_fields),
+            schema_fields=flatten_schema_fields(snap.schema_fields),
             options=tuple(options),
         )
         return DataFrame(self._session, Scan(rel))
@@ -104,11 +111,13 @@ class DataFrameReader:
         options = [("snapshotId", str(snap.snapshot_id))]
         if snapshot_id is not None:
             options.append(("snapshotAsOf", str(snapshot_id)))
+        from hyperspace_tpu.io.columnar import flatten_schema_fields
+
         rel = Relation(
             root_paths=(os.path.abspath(path),),
             files=tuple(snap.file_paths),
             fmt="iceberg",
-            schema_fields=tuple(snap.schema_fields),
+            schema_fields=flatten_schema_fields(snap.schema_fields),
             options=tuple(options),
         )
         return DataFrame(self._session, Scan(rel))

@@ -38,6 +38,18 @@ class ResolvedColumn:
         return ResolvedColumn(name, False)
 
 
+def nested_available_from(column_names: Iterable[str]) -> List[str]:
+    """The dotted struct paths a relation surfaces, derived from its
+    flattened ``__hs_nested.``-prefixed columns (io/columnar.py
+    ``flatten_schema_fields``) — the ``nested_available`` input to
+    :func:`resolve`."""
+    return [
+        c[len(NESTED_FIELD_PREFIX):]
+        for c in column_names
+        if c.startswith(NESTED_FIELD_PREFIX)
+    ]
+
+
 def resolve_one(
     requested: str, available: Sequence[str], case_sensitive: bool = False
 ) -> Optional[str]:

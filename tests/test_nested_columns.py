@@ -1,0 +1,280 @@
+"""Nested (struct) column indexing end-to-end.
+
+Reference: ``util/ResolverUtils.scala:130-234`` (nested fields flattened
+to ``__hs_nested.``-prefixed columns), ``actions/CreateAction.scala:69-71``
+(opt-in gate). Here the flattening happens at relation construction
+(io/columnar.flatten_schema_fields): struct leaves are first-class flat
+columns everywhere, virtual over source files (struct-root extraction at
+read, io/parquet._resolve_nested_columns) and literal inside index data.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.constants import NESTED_FIELD_PREFIX
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+from hyperspace_tpu.io.columnar import flatten_schema_fields
+
+
+def sorted_table(t: pa.Table) -> pa.Table:
+    return t.sort_by([(c, "ascending") for c in t.column_names])
+
+
+class TestFlattenSchema:
+    def test_struct_flattened_depth_first(self):
+        t = pa.struct(
+            [
+                ("leaf", pa.struct([("cnt", pa.int64())])),
+                ("id", pa.string()),
+            ]
+        )
+        out = flatten_schema_fields((("k", pa.int64()), ("nested", t)))
+        assert out == (
+            ("k", pa.int64()),
+            (NESTED_FIELD_PREFIX + "nested.leaf.cnt", pa.int64()),
+            (NESTED_FIELD_PREFIX + "nested.id", pa.string()),
+        )
+
+    def test_list_leaves_dropped(self):
+        t = pa.struct([("xs", pa.list_(pa.int64())), ("v", pa.float64())])
+        out = flatten_schema_fields((("s", t),))
+        assert out == ((NESTED_FIELD_PREFIX + "s.v", pa.float64()),)
+
+    def test_plain_fields_untouched(self):
+        fields = (("a", pa.int64()), ("b", pa.string()))
+        assert flatten_schema_fields(fields) == fields
+
+    def test_fixed_size_list_leaf_dropped(self):
+        t = pa.struct(
+            [("fs", pa.list_(pa.int64(), 2)), ("v", pa.int64())]
+        )
+        out = flatten_schema_fields((("s", t),))
+        assert out == ((NESTED_FIELD_PREFIX + "s.v", pa.int64()),)
+
+    def test_dotted_field_names_dropped(self):
+        # a field name containing '.' cannot round-trip through the dotted
+        # flattened name — it must be skipped, not mis-split at read time
+        t = pa.struct([("a.b", pa.int64()), ("v", pa.int64())])
+        out = flatten_schema_fields((("s", t),))
+        assert out == ((NESTED_FIELD_PREFIX + "s.v", pa.int64()),)
+        # dotted struct ROOT name: left as-is (no flattening)
+        out2 = flatten_schema_fields((("x.y", t),))
+        assert out2 == (("x.y", t),)
+
+
+@pytest.fixture
+def hs(session):
+    return Hyperspace(session)
+
+
+def _nested_dataset(tmp_path, n=600, n_files=3):
+    """Rows with a struct column: nested = {leaf: {cnt}, id}, plus nulls."""
+    d = tmp_path / "nested_tbl"
+    d.mkdir()
+    rng = np.random.default_rng(21)
+    per = n // n_files
+    for i in range(n_files):
+        cnt = rng.integers(0, 40, per)
+        rows = []
+        for j in range(per):
+            if (i * per + j) % 97 == 0:
+                rows.append(None)  # null struct row
+            else:
+                rows.append(
+                    {"leaf": {"cnt": int(cnt[j])}, "id": f"id{(i * per + j) % 9}"}
+                )
+        t = pa.table(
+            {
+                "k": pa.array(
+                    rng.integers(0, 50, per).astype(np.int64)
+                ),
+                "v": pa.array(rng.normal(0, 1, per)),
+                "nested": pa.array(
+                    rows,
+                    type=pa.struct(
+                        [
+                            ("leaf", pa.struct([("cnt", pa.int64())])),
+                            ("id", pa.string()),
+                        ]
+                    ),
+                ),
+            }
+        )
+        pq.write_table(t, str(d / f"p{i}.parquet"))
+    return str(d)
+
+
+class TestNestedScan:
+    def test_scan_surfaces_flattened_columns(self, session, tmp_path):
+        df = session.read.parquet(_nested_dataset(tmp_path))
+        assert NESTED_FIELD_PREFIX + "nested.leaf.cnt" in df.columns
+        assert NESTED_FIELD_PREFIX + "nested.id" in df.columns
+        assert "nested" not in df.columns
+
+    def test_dotted_access_resolves(self, session, tmp_path):
+        df = session.read.parquet(_nested_dataset(tmp_path))
+        col = df["nested.leaf.cnt"]
+        assert col.name == NESTED_FIELD_PREFIX + "nested.leaf.cnt"
+
+    def test_unindexed_select_and_filter(self, session, tmp_path):
+        src = _nested_dataset(tmp_path)
+        df = session.read.parquet(src)
+        out = df.filter(df["nested.leaf.cnt"] == 7).select(
+            "k", "nested.leaf.cnt"
+        ).collect()
+        # oracle: pyarrow-level recomputation
+        import pyarrow.compute as pc
+
+        raw = pq.read_table(sorted(
+            os.path.join(src, f) for f in os.listdir(src)
+        ))
+        cnt = pc.struct_field(raw.column("nested"), ["leaf", "cnt"])
+        expected = pc.sum(
+            pc.fill_null(pc.equal(cnt, 7), False).cast(pa.int64())
+        ).as_py()
+        assert out.num_rows == expected > 0
+        assert set(out.column_names) == {
+            "k",
+            NESTED_FIELD_PREFIX + "nested.leaf.cnt",
+        }
+
+    def test_group_by_sort_agg_resolve_dotted(self, session, tmp_path):
+        from hyperspace_tpu import functions as F
+
+        df = session.read.parquet(_nested_dataset(tmp_path))
+        out = (
+            df.group_by("nested.id")
+            .agg(F.count(), F.max("nested.leaf.cnt").alias("m"))
+            .collect()
+        )
+        assert out.num_rows > 0
+        srt = df.select("k", "nested.leaf.cnt").sort("nested.leaf.cnt").collect()
+        col = srt.column(NESTED_FIELD_PREFIX + "nested.leaf.cnt").to_pylist()
+        non_null = [v for v in col if v is not None]
+        assert non_null == sorted(non_null)
+
+    def test_null_struct_rows_are_null_leaves(self, session, tmp_path):
+        df = session.read.parquet(_nested_dataset(tmp_path))
+        t = df.select("nested.id").collect()
+        assert t.column(0).null_count > 0
+
+
+class TestNestedIndexing:
+    def test_create_gate_requires_conf(self, session, hs, tmp_path):
+        df = session.read.parquet(_nested_dataset(tmp_path))
+        with pytest.raises(HyperspaceException, match="supportNestedFields"):
+            hs.create_index(
+                df, CoveringIndexConfig("nix", ["nested.leaf.cnt"], ["v"])
+            )
+
+    def test_filter_served_and_differential(self, session, hs, tmp_path):
+        src = _nested_dataset(tmp_path)
+        df = session.read.parquet(src)
+        session.conf.set(C.INDEX_SUPPORT_NESTED_FIELDS, True)
+        hs.create_index(
+            df,
+            CoveringIndexConfig(
+                "nix", ["nested.leaf.cnt"], ["k", "nested.id"]
+            ),
+        )
+        entry = session.index_manager.get_index_log_entry("nix")
+        assert entry.derived_dataset.indexed_columns == [
+            NESTED_FIELD_PREFIX + "nested.leaf.cnt"
+        ]
+
+        def q(d):
+            return d.filter(d["nested.leaf.cnt"] == 7).select(
+                "k", "nested.id"
+            )
+
+        session.enable_hyperspace()
+        plan = q(df).explain()
+        assert "Hyperspace(Type: CI, Name: nix" in plan
+        with_index = sorted_table(q(df).collect())
+        session.disable_hyperspace()
+        without = sorted_table(q(df).collect())
+        assert with_index.equals(without)
+        assert with_index.num_rows > 0
+
+    def test_join_on_nested_key_differential(self, session, hs, tmp_path):
+        src = _nested_dataset(tmp_path)
+        df = session.read.parquet(src)
+        dim = tmp_path / "dim"
+        dim.mkdir()
+        pq.write_table(
+            pa.table(
+                {
+                    "cnt_key": np.arange(40, dtype=np.int64),
+                    "label": pa.array([f"L{v}" for v in range(40)]),
+                }
+            ),
+            str(dim / "d.parquet"),
+        )
+        dfd = session.read.parquet(str(dim))
+        session.conf.set(C.INDEX_SUPPORT_NESTED_FIELDS, True)
+        hs.create_index(
+            df, CoveringIndexConfig("nj", ["nested.leaf.cnt"], ["k"])
+        )
+        hs.create_index(dfd, CoveringIndexConfig("dj", ["cnt_key"], ["label"]))
+
+        def q():
+            j = dfd.join(df, on=dfd["cnt_key"] == df["nested.leaf.cnt"])
+            return j.select("cnt_key", "label", "k")
+
+        session.enable_hyperspace()
+        plan = q().explain()
+        assert plan.count("Hyperspace(Type: CI") == 2
+        with_index = sorted_table(q().collect())
+        session.disable_hyperspace()
+        without = sorted_table(q().collect())
+        assert with_index.equals(without)
+        assert with_index.num_rows > 0
+
+    def test_incremental_refresh_with_nested(self, session, hs, tmp_path):
+        src = _nested_dataset(tmp_path)
+        df = session.read.parquet(src)
+        session.conf.set(C.INDEX_SUPPORT_NESTED_FIELDS, True)
+        session.conf.set(C.INDEX_LINEAGE_ENABLED, True)
+        hs.create_index(
+            df, CoveringIndexConfig("nr", ["nested.leaf.cnt"], ["k"])
+        )
+        session.enable_hyperspace()
+
+        def q(d):
+            return d.filter(d["nested.leaf.cnt"] == 7).select("k")
+
+        before = q(df).collect().num_rows
+        extra = pa.table(
+            {
+                "k": pa.array([999, 998], type=pa.int64()),
+                "v": pa.array([0.0, 0.0]),
+                "nested": pa.array(
+                    [
+                        {"leaf": {"cnt": 7}, "id": "new"},
+                        {"leaf": {"cnt": 8}, "id": "new"},
+                    ],
+                    type=pa.struct(
+                        [
+                            ("leaf", pa.struct([("cnt", pa.int64())])),
+                            ("id", pa.string()),
+                        ]
+                    ),
+                ),
+            }
+        )
+        pq.write_table(extra, os.path.join(src, "extra.parquet"))
+        hs.refresh_index("nr", C.REFRESH_MODE_INCREMENTAL)
+        session.index_manager.clear_cache()
+        df2 = session.read.parquet(src)
+        plan = q(df2).explain()
+        assert "Hyperspace(Type: CI, Name: nr" in plan
+        with_index = q(df2).collect()
+        session.disable_hyperspace()
+        assert q(df2).collect().num_rows == with_index.num_rows == before + 1
