@@ -575,15 +575,21 @@ def _pushable_literal(value, arrow_type):
 
 
 def _pushdown_filters(cond: E.Expr, rel):
-    """Pyarrow DNF filter (single conjunction) for parquet ROW-GROUP
-    pruning from the predicate's simple conjuncts.
+    """Pyarrow DNF filter (single conjunction) from the predicate's
+    simple conjuncts.
 
-    Sound by construction: only conjuncts whose pyarrow evaluation keeps a
-    superset of the rows the engine's own mask keeps are pushed (plain
-    col-op-literal and IN; null/NaN drop the same rows in both engines),
-    and the executor re-applies the full mask after the read. On a
-    key-sorted index bucket this turns a point lookup into a read of the
-    one row group whose min/max covers the key.
+    Sound by construction under the ROW-LEVEL-superset invariant
+    (``io/parquet.read_table``): pyarrow >= 14 applies these filters per
+    row via the dataset API, so every pushed conjunct's pyarrow
+    evaluation must keep a row-level superset of the rows the engine's
+    own mask keeps — merely row-group-safe conjuncts (e.g. literals
+    rounded toward engine semantics) must NOT be pushed. Today only
+    plain col-op-literal and IN with exactly-representable literals
+    qualify (null/NaN drop the same rows in both engines;
+    ``_pushable_literal`` refuses lossy literal conversions), and the
+    executor re-applies the full mask after the read. On a key-sorted
+    index bucket this turns a point lookup into a read of the one row
+    group whose min/max covers the key.
     """
     if rel.fmt not in ("parquet", "delta", "iceberg"):
         return None

@@ -25,9 +25,9 @@ Datasets larger than the configured memory budget
 ``create_covering_index`` hands back a lazy :class:`SourceScan` and
 ``_write_bucketed_streaming`` runs the pipeline in waves with per-bucket
 disk spill and a final per-bucket merge sort (peak memory = one wave +
-one bucket). The delete-compensation path of incremental refresh still
-materializes the previous index data (bounded by the index, not the
-source).
+one bucket). Incremental refresh streams BOTH sides the same way: the
+appended source files and — via ``SourceScan.excluded_lineage_ids`` —
+the previous index data minus deleted-lineage rows.
 """
 
 from __future__ import annotations
@@ -76,15 +76,6 @@ def _scan_with_lineage(
     return ColumnarBatch.concat(batches)
 
 
-def materialize_if_scan(data) -> ColumnarBatch:
-    """ColumnarBatch passthrough; a lazy :class:`SourceScan` is read whole.
-
-    For consumers that need the data in memory regardless of the build
-    memory budget — today only the z-order INCREMENTAL refresh delta
-    (small by construction; create/full-refresh z-order builds stream)."""
-    return data.materialize() if isinstance(data, SourceScan) else data
-
-
 @dataclasses.dataclass
 class SourceScan:
     """Lazy build-side input: what to read, not the rows themselves.
@@ -105,6 +96,10 @@ class SourceScan:
     # per-file estimated materialized bytes, computed once at create time
     # (footer parses are a round trip each on object stores)
     file_sizes: Optional[Tuple[int, ...]] = None
+    # rows whose stored lineage id is listed are dropped at materialize
+    # time — lets refresh's delete compensation stream previous index
+    # data instead of materializing it whole
+    excluded_lineage_ids: Optional[Tuple[int, ...]] = None
 
     def materialize(self, files: Optional[Sequence[str]] = None) -> ColumnarBatch:
         batch = _scan_with_lineage(
@@ -113,12 +108,91 @@ class SourceScan:
             list(self.columns),
             self.file_ids,
         )
+        if self.excluded_lineage_ids:
+            lineage = batch.column(DATA_FILE_NAME_ID).values
+            keep = ~np.isin(
+                lineage, np.array(self.excluded_lineage_ids, dtype=np.int64)
+            )
+            batch = batch.filter(keep)
         if self.select_cols is not None:
             batch = batch.select(list(self.select_cols))
         return batch
 
     def select(self, cols: Sequence[str]) -> "SourceScan":
         return dataclasses.replace(self, select_cols=tuple(cols))
+
+    def stats_view(self, stat_cols: Sequence[str]) -> "SourceScan":
+        """A projection of this scan reading only ``stat_cols`` (plus the
+        lineage column when delete exclusion applies, so excluded rows do
+        not contribute to encoding statistics)."""
+        cols = tuple(stat_cols)
+        read = cols
+        if self.excluded_lineage_ids and DATA_FILE_NAME_ID not in read:
+            read = read + (DATA_FILE_NAME_ID,)
+        return dataclasses.replace(
+            self, columns=read, file_ids=None, select_cols=cols
+        )
+
+    def estimated_bytes(self) -> int:
+        if self.file_sizes is not None:
+            return sum(self.file_sizes)
+        return estimated_materialized_bytes(self.files, self.fmt)
+
+
+@dataclasses.dataclass
+class CompositeScan:
+    """Several :class:`SourceScan` parts streamed as one input.
+
+    Incremental refresh mixes heterogeneous inputs — appended SOURCE
+    files (projection + lineage attach) and previous INDEX files
+    (lineage-filtered for deletes). Each keeps its own read semantics;
+    wave planning and materialization see one ordered file list. All
+    parts must select the same output columns."""
+
+    scans: Tuple[SourceScan, ...]
+
+    @property
+    def files(self) -> Tuple[str, ...]:
+        return tuple(f for s in self.scans for f in s.files)
+
+    @property
+    def fmt(self) -> str:
+        return self.scans[0].fmt
+
+    @property
+    def file_sizes(self) -> Tuple[int, ...]:
+        out: List[int] = []
+        for s in self.scans:
+            out.extend(
+                s.file_sizes
+                if s.file_sizes is not None
+                else per_file_materialized_bytes(s.files, s.fmt)
+            )
+        return tuple(out)
+
+    def materialize(self, files: Optional[Sequence[str]] = None) -> ColumnarBatch:
+        wanted = set(self.files if files is None else files)
+        parts = []
+        # scans are ordered and wave file lists are contiguous slices of
+        # self.files, so per-scan grouping preserves global row order
+        for s in self.scans:
+            sub = [f for f in s.files if f in wanted]
+            if sub:
+                parts.append(s.materialize(sub))
+        if not parts:
+            raise HyperspaceException("No files to materialize")
+        return ColumnarBatch.concat(parts)
+
+    def select(self, cols: Sequence[str]) -> "CompositeScan":
+        return CompositeScan(tuple(s.select(cols) for s in self.scans))
+
+    def stats_view(self, stat_cols: Sequence[str]) -> "CompositeScan":
+        return CompositeScan(
+            tuple(s.stats_view(stat_cols) for s in self.scans)
+        )
+
+    def estimated_bytes(self) -> int:
+        return sum(s.estimated_bytes() for s in self.scans)
 
 
 def per_file_materialized_bytes(files: Sequence[str], fmt: str) -> List[int]:
@@ -220,9 +294,11 @@ def _single_relation(source_df):
     return leaves[0].relation
 
 
-def create_covering_index(ctx, source_df, config, properties: Dict[str, str]):
-    """(CoveringIndex, index_data batch) — the reference's
-    ``CoveringIndexConfig.createIndex:43-61``."""
+def prepare_covering_index(ctx, source_df, config, properties: Dict[str, str]):
+    """(CoveringIndex, lazy SourceScan) — the resolution + lineage-id
+    registration half of index creation, with the data side still lazy
+    (callers that stream — the z-order incremental refresh — compose the
+    scan further before any row is read)."""
     from hyperspace_tpu.indexes.covering import CoveringIndex
 
     rel = _single_relation(source_df)
@@ -254,9 +330,49 @@ def create_covering_index(ctx, source_df, config, properties: Dict[str, str]):
         file_ids=file_ids,
         file_sizes=tuple(sizes) if sizes is not None else None,
     )
-    if budget and sum(sizes) > budget:
-        return index, scan  # streamed at write time (wave loop)
-    return index, scan.materialize()
+    return index, scan
+
+
+def lazy_or_materialized(ctx, scan):
+    """THE build memory-budget rule, in one place: keep the scan lazy
+    (streamed at write time through the wave loop) when its estimated
+    materialized size exceeds ``hyperspace.index.build.memoryBudgetBytes``,
+    else materialize now. Accepts SourceScan or CompositeScan."""
+    budget = ctx.session.conf.build_memory_budget
+    if budget and scan.estimated_bytes() > budget:
+        return scan
+    return scan.materialize()
+
+
+def previous_index_scan(
+    ctx, previous_content, schema_cols, deleted_source_file_ids
+):
+    """Lazy scan of a previous index version's data files minus
+    deleted-lineage rows (the refresh delete-compensation input). File
+    sizes are computed once here when a budget is set — footer parses
+    are a round trip each on object stores."""
+    files = tuple(previous_content.files)
+    sizes = (
+        tuple(per_file_materialized_bytes(files, "parquet"))
+        if ctx.session.conf.build_memory_budget
+        else None
+    )
+    return SourceScan(
+        files=files,
+        fmt="parquet",
+        columns=tuple(schema_cols),
+        file_ids=None,
+        select_cols=tuple(schema_cols),
+        file_sizes=sizes,
+        excluded_lineage_ids=tuple(deleted_source_file_ids),
+    )
+
+
+def create_covering_index(ctx, source_df, config, properties: Dict[str, str]):
+    """(CoveringIndex, index_data batch) — the reference's
+    ``CoveringIndexConfig.createIndex:43-61``."""
+    index, scan = prepare_covering_index(ctx, source_df, config, properties)
+    return index, lazy_or_materialized(ctx, scan)
 
 
 def source_file_infos(session, plan_relation) -> List[Tuple[str, int, int]]:
@@ -493,14 +609,13 @@ def refresh_incremental(
             raise HyperspaceException(
                 "Cannot handle deleted source files without lineage"
             )
-        old = ColumnarBatch.from_arrow(
-            pio.read_table(list(previous_content.files), None)
+        # previous index data minus deleted-lineage rows, as a LAZY scan:
+        # beyond the memory budget it streams through the wave loop like
+        # the appended side instead of materializing whole
+        old_scan = previous_index_scan(
+            ctx, previous_content, schema_cols, deleted_source_file_ids
         )
-        lineage = old.column(DATA_FILE_NAME_ID).values
-        keep = ~np.isin(
-            lineage, np.array(deleted_source_file_ids, dtype=np.int64)
-        )
-        parts.append(old.filter(keep).select(schema_cols))
+        parts.append(lazy_or_materialized(ctx, old_scan))
         mode = UpdateMode.OVERWRITE
     else:
         mode = UpdateMode.MERGE

@@ -110,42 +110,49 @@ class ZOrderCoveringIndex(Index):
     ) -> Tuple["ZOrderCoveringIndex", UpdateMode]:
         """Like the covering index, but the new data is z-sorted on its own
         (a merged global re-sort would be a full rebuild; the reference
-        likewise z-sorts only the delta)."""
-        from hyperspace_tpu.indexes import covering_build
+        likewise z-sorts only the delta).
+
+        The refresh input — appended source files and, for deletes, the
+        lineage-filtered previous index data — is assembled LAZILY
+        (SourceScan/CompositeScan) and only materialized when it fits the
+        build memory budget; otherwise it streams through the same
+        two-pass wave loop as create/full refresh."""
+        from hyperspace_tpu.indexes.covering_build import (
+            CompositeScan,
+            lazy_or_materialized,
+            prepare_covering_index,
+            previous_index_scan,
+        )
 
         schema_cols = self._indexed_columns + self._included_columns
         if self.lineage_enabled:
             schema_cols = schema_cols + [DATA_FILE_NAME_ID]
-        parts = []
+        scans = []
         if appended_df is not None:
-            _idx, data = covering_build.create_covering_index(
+            _idx, scan = prepare_covering_index(
                 ctx, appended_df, self._config(), dict(self.properties)
             )
-            # incremental refresh z-sorts the delta on its own and the
-            # delta is small by construction; the full/create paths stream
-            parts.append(
-                covering_build.materialize_if_scan(data).select(schema_cols)
-            )
+            scans.append(scan.select(schema_cols))
         if deleted_source_file_ids:
             if not self.lineage_enabled:
                 raise HyperspaceException(
                     "Cannot handle deleted source files without lineage"
                 )
-            old = ColumnarBatch.from_arrow(
-                pio.read_table(list(previous_content.files), None)
+            scans.append(
+                previous_index_scan(
+                    ctx, previous_content, schema_cols, deleted_source_file_ids
+                )
             )
-            lineage = old.column(DATA_FILE_NAME_ID).values
-            keep = ~np.isin(
-                lineage, np.array(deleted_source_file_ids, dtype=np.int64)
-            )
-            parts.append(old.filter(keep).select(schema_cols))
             mode = UpdateMode.OVERWRITE
         else:
             mode = UpdateMode.MERGE
-        if parts:
-            batch = ColumnarBatch.concat(parts)
+        if scans:
+            combined = scans[0] if len(scans) == 1 else CompositeScan(tuple(scans))
             _write_zordered(
-                ctx, batch, self._indexed_columns, self.target_bytes_per_partition
+                ctx,
+                lazy_or_materialized(ctx, combined),
+                self._indexed_columns,
+                self.target_bytes_per_partition,
             )
         return self, mode
 
@@ -189,11 +196,11 @@ def _write_zordered(
     the build memory budget) a lazy SourceScan streamed in two passes."""
     import os
 
-    from hyperspace_tpu.indexes.covering_build import SourceScan
+    from hyperspace_tpu.indexes.covering_build import CompositeScan, SourceScan
     from hyperspace_tpu.ops.zorder import z_order_permutation
 
     os.makedirs(ctx.index_data_path, exist_ok=True)
-    if isinstance(data, SourceScan):
+    if isinstance(data, (SourceScan, CompositeScan)):
         return _write_zordered_streaming(
             ctx, data, indexed_cols, target_bytes
         )
@@ -260,11 +267,7 @@ def _write_zordered_streaming(
     waves = plan_waves(scan.files, scan.fmt, budget, scan.file_sizes)
 
     # pass 1: frozen encoding spec from a stats-only scan
-    import dataclasses
-
-    stats_scan = dataclasses.replace(
-        scan, columns=tuple(indexed_cols), file_ids=None, select_cols=None
-    )
+    stats_scan = scan.stats_view(indexed_cols)
     k = len(indexed_cols)
     mins = [None] * k
     maxs = [None] * k
@@ -340,13 +343,28 @@ def _write_zordered_streaming(
 
         # merge: per z-range ascending, local sort == global order.
         # A skewed/constant key can funnel most rows into ONE range;
-        # oversized ranges split recursively on deeper z-address bits,
-        # and when the bits are exhausted (all rows share one z-address,
-        # whose relative order is semantically arbitrary) each part is
-        # sorted and written individually — peak memory stays bounded.
+        # oversized ranges split recursively on deeper z-address bits —
+        # through the remaining windows of plane 0, then every deeper
+        # plane — and only when EVERY bit of every plane is exhausted
+        # (all rows share one complete z-address, whose relative order is
+        # semantically arbitrary) is each part sorted and written
+        # individually. Peak memory stays bounded either way.
         from hyperspace_tpu.indexes.covering_build import (
             estimated_materialized_bytes,
         )
+
+        total_bits = len(indexed_cols) * encoder.bits
+        n_planes = max(1, (total_bits + 31) // 32)
+
+        def plane_floor(plane_idx):
+            """Lowest MEANINGFUL bit of a plane: the last plane's tail
+            below 32 - (total_bits mod 32) is zero padding — descending
+            into it would read and rewrite oversized groups without
+            discriminating anything."""
+            if plane_idx == n_planes - 1:
+                rem = total_bits - 32 * (n_planes - 1)
+                return 32 - rem
+            return 0
 
         written: List[str] = []
         state = {"file_idx": 0}
@@ -373,12 +391,27 @@ def _write_zordered_streaming(
             )
             return batch.take(perm).to_arrow()
 
-        def merge_parts(parts, shift):
+        def next_window(plane_idx, shift):
+            """The split window after (plane_idx, shift): slide down the
+            current plane (clamping the last window to the plane's floor
+            so the lowest meaningful bits still discriminate), then
+            advance to the next plane."""
+            floor = plane_floor(plane_idx)
+            if shift > floor:
+                return plane_idx, max(shift - _ZORDER_SPILL_BITS, floor)
+            nxt = plane_idx + 1
+            return nxt, max(
+                32 - _ZORDER_SPILL_BITS,
+                plane_floor(nxt) if nxt < n_planes else 0,
+            )
+
+        def merge_parts(parts, plane_idx, shift):
             est = estimated_materialized_bytes(parts, "parquet")
-            if est <= budget or shift < 0:
-                if shift < 0 and est > budget:
-                    # single z-address dominates: order among equal
-                    # addresses is arbitrary — sort parts independently
+            if est <= budget or plane_idx >= n_planes:
+                if plane_idx >= n_planes and est > budget:
+                    # every z-address bit is exhausted: rows share one
+                    # complete z-address, whose relative order is
+                    # arbitrary — sort parts independently
                     for part in parts:
                         write_sorted(
                             sort_batch(
@@ -394,15 +427,15 @@ def _write_zordered_streaming(
                     )
                 )
                 return
-            # split on the next _ZORDER_SPILL_BITS bits of plane 0
+            # split on the window's _ZORDER_SPILL_BITS bits of this plane
             sub_parts: dict = {}
-            next_shift = shift - _ZORDER_SPILL_BITS
+            nxt = next_window(plane_idx, shift)
             for part in parts:
                 b = ColumnarBatch.from_arrow(pio.read_table([part], None))
-                planes0 = encoder.planes(
+                plane = encoder.planes(
                     [b.column(c) for c in indexed_cols]
-                )[0]
-                sub = ((planes0 >> np.uint32(max(shift, 0)))
+                )[plane_idx]
+                sub = ((plane >> np.uint32(shift))
                        & np.uint32((1 << _ZORDER_SPILL_BITS) - 1)).astype(
                     np.int32
                 )
@@ -412,11 +445,11 @@ def _write_zordered_streaming(
                     pio.write_table(path, table.take(pa.array(idx)))
                     sub_parts.setdefault(sp, []).append(path)
             for sp in sorted(sub_parts):
-                merge_parts(sub_parts[sp], next_shift)
+                merge_parts(sub_parts[sp], *nxt)
 
         for p in sorted(range_parts):
             merge_parts(
-                range_parts[p], 32 - 2 * _ZORDER_SPILL_BITS
+                range_parts[p], 0, 32 - 2 * _ZORDER_SPILL_BITS
             )
         return written
     finally:

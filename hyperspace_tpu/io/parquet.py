@@ -102,12 +102,21 @@ def read_table(
     filters=None,
 ) -> pa.Table:
     """Read and concatenate files into one Arrow table (row order follows
-    ``paths`` order, file by file). ``filters`` (parquet-like formats
-    only) is a pyarrow DNF conjunction used for ROW-GROUP pruning — the
-    executor re-applies its own mask afterwards, so filters only need to
-    keep a superset of matching rows. ``__hs_nested.``-prefixed columns
-    that are not literal flat columns in the files are served by reading
-    the struct root and extracting the leaf (``_resolve_nested_columns``)."""
+    ``paths`` order, file by file).
+
+    ``filters`` (parquet-like formats only) is a pyarrow DNF conjunction.
+    REQUIRED INVARIANT: each pushed conjunct must keep a **row-level
+    superset** of the rows the engine's own mask keeps — pyarrow >= 14
+    routes ``pq.read_table`` through the dataset API, which applies
+    filters per ROW (not merely per row group), so a conjunct that is
+    only row-group-safe (e.g. a literal rounded/snapped toward the
+    engine's semantics) would silently drop matching rows. The executor
+    re-applies the full mask afterwards, so over-keeping is always safe;
+    under-keeping never is.
+
+    ``__hs_nested.``-prefixed columns that are not literal flat columns
+    in the files are served by reading the struct root and extracting
+    the leaf (``_resolve_nested_columns``)."""
     if columns:
         read_cols, extract = _resolve_nested_columns(paths, columns, fmt)
         if extract:

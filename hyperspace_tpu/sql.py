@@ -370,15 +370,24 @@ class _Parser:
         return node(E.Col(name), right)
 
     def _operand(self) -> E.Expr:
+        """A comparison's right side: a column reference or a literal.
+
+        ``TRUE``/``FALSE``/``NULL`` are reserved words (a column literally
+        named one of them cannot appear as a bare operand — quote-free
+        SQL has no way to disambiguate). ``DATE`` is only a keyword when
+        a quoted string follows (``DATE '1994-01-01'``); otherwise it is
+        an ordinary column name."""
         kind, val = self.peek()
-        if kind == "ident" and val.lower() not in (
-            "true",
-            "false",
-            "null",
-            "date",
-        ):
-            self.next()
-            return E.Col(val)
+        if kind == "ident":
+            low = val.lower()
+            is_date_literal = (
+                low == "date"
+                and self.i + 1 < len(self.toks)
+                and self.toks[self.i + 1][0] == "string"
+            )
+            if low not in ("true", "false", "null") and not is_date_literal:
+                self.next()
+                return E.Col(val)
         return E.Lit(self._literal())
 
     def _literal(self):
@@ -406,7 +415,8 @@ class _Parser:
                     raise HyperspaceException("DATE takes a quoted literal")
                 import numpy as np
 
-                return np.datetime64(v2[1:-1])
+                # same doubled-quote unescape as plain string literals
+                return np.datetime64(v2[1:-1].replace("''", "'"))
         raise HyperspaceException(f"Expected literal, got {val!r}")
 
 

@@ -493,3 +493,79 @@ class TestLimitPushdown:
         df = session.read.parquet(d)
         out = df.sort(("x", False)).limit(4).collect()
         assert out.column("x").to_pylist() == [199, 198, 197, 196]
+
+
+class TestRowLevelPushdownSuperset:
+    """Pushed filters must keep a ROW-LEVEL superset of engine-matching
+    rows — pyarrow >= 14 applies pq.read_table filters per row (dataset
+    API), not merely per row group (io/parquet.read_table invariant).
+    Pins the conjunct classes whose row-level semantics could diverge."""
+
+    def _roundtrip(self, session, tmp_path, table, q):
+        """collect() with normal pushdown vs pushdown force-disabled."""
+        import hyperspace_tpu.execution.executor as X
+
+        d = tmp_path / "rl"
+        d.mkdir(exist_ok=True)
+        pq.write_table(table, d / "a.parquet")
+        df = session.read.parquet(str(d))
+        with_push = q(df).collect()
+        real = X._pushdown_filters
+        X._pushdown_filters = lambda cond, rel: None
+        try:
+            without = q(df).collect()
+        finally:
+            X._pushdown_filters = real
+        key = lambda t: t.sort_by([(c, "ascending") for c in t.column_names])
+        assert key(with_push).equals(key(without))
+        return with_push
+
+    def test_between_tick_timestamp_not_pushed(self, session, tmp_path):
+        from hyperspace_tpu.execution.executor import _pushable_literal
+
+        # a microsecond literal against a SECOND-resolution column is not
+        # exactly representable: pushing it would let arrow's cast choose
+        # a rounding the engine does not use — it must be refused
+        lit = np.datetime64("2020-01-01T00:00:00.5", "us")
+        assert _pushable_literal(lit, pa.timestamp("s")) is None
+        t = pa.table(
+            {
+                "ts": pa.array(
+                    np.array(
+                        ["2020-01-01T00:00:00", "2020-01-01T00:00:01"],
+                        dtype="datetime64[s]",
+                    )
+                ),
+                "v": pa.array([1, 2], pa.int64()),
+            }
+        )
+        out = self._roundtrip(
+            session, tmp_path, t,
+            lambda df: df.filter(df["ts"] == lit).select("v"),
+        )
+        assert out.num_rows == 0  # engine: between-tick literal never matches
+
+    def test_negative_zero_and_nan_equality(self, session, tmp_path):
+        t = pa.table(
+            {
+                "x": pa.array([0.0, -0.0, float("nan"), 1.0]),
+                "v": pa.array([1, 2, 3, 4], pa.int64()),
+            }
+        )
+        out = self._roundtrip(
+            session, tmp_path, t,
+            lambda df: df.filter(df["x"] == 0.0).select("v"),
+        )
+        # IEEE: -0.0 == 0.0 matches; NaN never does — in BOTH engines
+        assert sorted(out.column("v").to_pylist()) == [1, 2]
+
+    def test_out_of_int64_range_literal_not_pushed(self, session, tmp_path):
+        from hyperspace_tpu.execution.executor import _pushable_literal
+
+        assert _pushable_literal(2**63, pa.int64()) is None
+        t = pa.table({"k": pa.array([1, 2], pa.int64())})
+        out = self._roundtrip(
+            session, tmp_path, t,
+            lambda df: df.filter(df["k"] == 2**63).select("k"),
+        )
+        assert out.num_rows == 0

@@ -166,3 +166,48 @@ class TestSqlUsesIndexes:
         base = session.sql("SELECT k, qty FROM items WHERE k = 7").collect()
         assert sorted_table(got).equals(sorted_table(base))
         assert got.num_rows > 0
+
+
+class TestDateKeywordDisambiguation:
+    """`DATE` is a keyword only when a quoted string follows; a column
+    literally named `date` stays usable as a comparison operand."""
+
+    @pytest.fixture
+    def date_view(self, session, tmp_path):
+        d = tmp_path / "dated"
+        d.mkdir()
+        pq.write_table(
+            pa.table(
+                {
+                    "a": pa.array(["x", "y", "z", "y"]),
+                    "date": pa.array(["x", "q", "z", "n"]),
+                    "d": pa.array(
+                        np.array(
+                            ["1994-01-01", "1995-06-01", "1994-01-01", "1996-01-01"],
+                            dtype="datetime64[D]",
+                        )
+                    ),
+                }
+            ),
+            d / "a.parquet",
+        )
+        session.register_view("dated", session.read.parquet(str(d)))
+        return session
+
+    def test_column_named_date_as_operand(self, date_view):
+        out = date_view.sql(
+            "SELECT a FROM dated WHERE a = date"
+        ).collect()
+        assert sorted(out.column("a").to_pylist()) == ["x", "z"]
+
+    def test_date_literal_still_parses(self, date_view):
+        out = date_view.sql(
+            "SELECT a FROM dated WHERE d = DATE '1994-01-01'"
+        ).collect()
+        assert sorted(out.column("a").to_pylist()) == ["x", "z"]
+
+    def test_column_named_date_on_left(self, date_view):
+        out = date_view.sql(
+            "SELECT date FROM dated WHERE date = 'q'"
+        ).collect()
+        assert out.column("date").to_pylist() == ["q"]

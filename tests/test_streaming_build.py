@@ -309,3 +309,127 @@ class TestStreamingBuild:
         got = q(df).collect()
         s = lambda t: t.sort_by([(c, "ascending") for c in t.column_names])
         assert s(got).equals(s(base))
+
+
+class TestStreamingIncrementalRefresh:
+    """Round-5: BOTH incremental-refresh inputs stream — appended source
+    files and (for deletes) the previous index data via
+    ``SourceScan.excluded_lineage_ids`` — for covering AND z-order."""
+
+    def _track(self, monkeypatch):
+        calls = []
+        real = SourceScan.materialize
+
+        def tracking(self, files=None):
+            calls.append(len(files if files is not None else self.files))
+            return real(self, files)
+
+        monkeypatch.setattr(SourceScan, "materialize", tracking)
+        return calls
+
+    def _mk(self, session, hs, src, config):
+        session.conf.set(C.INDEX_LINEAGE_ENABLED, True)
+        df = session.read.parquet(src)
+        hs.create_index(df, config)
+
+    def test_covering_delete_refresh_streams(
+        self, session, hs, wide_parquet, monkeypatch
+    ):
+        self._mk(
+            session, hs, wide_parquet,
+            CoveringIndexConfig("cdel", ["k"], ["v"]),
+        )
+        victims = sorted(os.listdir(wide_parquet))[:2]
+        for v in victims:
+            os.remove(os.path.join(wide_parquet, v))
+        calls = self._track(monkeypatch)
+        session.conf.set(C.INDEX_BUILD_MEMORY_BUDGET, 1)
+        session.index_manager.clear_cache()
+        hs.refresh_index("cdel", C.REFRESH_MODE_INCREMENTAL)
+        assert calls, "delete refresh bypassed the lazy scan"
+        # budget of 1 byte: every wave is a single file — the previous
+        # index data was never materialized whole
+        assert max(calls) == 1
+        session.index_manager.clear_cache()
+        df = session.read.parquet(wide_parquet)
+        q = lambda d: d.filter(d["k"] == 7).select("k", "v")
+        session.disable_hyperspace()
+        base = q(df).collect()
+        session.enable_hyperspace()
+        got = q(df).collect()
+        s = lambda t: t.sort_by([(c, "ascending") for c in t.column_names])
+        assert s(got).equals(s(base))
+
+    def test_zorder_incremental_refresh_streams(
+        self, session, hs, wide_parquet, monkeypatch
+    ):
+        from hyperspace_tpu.indexes.zorder import ZOrderCoveringIndexConfig
+
+        self._mk(
+            session, hs, wide_parquet,
+            ZOrderCoveringIndexConfig("zincr", ["k"], ["v"]),
+        )
+        # append two files AND delete one: the refresh must stream the
+        # appended source and the lineage-filtered previous index data
+        rng = np.random.default_rng(11)
+        for i in range(2):
+            t = pa.table(
+                {
+                    "k": pa.array(rng.integers(0, 500, 4000), type=pa.int64()),
+                    "v": pa.array(rng.normal(size=4000)),
+                }
+            )
+            pq.write_table(
+                t, os.path.join(wide_parquet, f"zextra-{i}.parquet")
+            )
+        victim = sorted(
+            f for f in os.listdir(wide_parquet) if f.startswith("part-")
+        )[0]
+        os.remove(os.path.join(wide_parquet, victim))
+        calls = self._track(monkeypatch)
+        session.conf.set(C.INDEX_BUILD_MEMORY_BUDGET, 1)
+        session.index_manager.clear_cache()
+        hs.refresh_index("zincr", C.REFRESH_MODE_INCREMENTAL)
+        assert calls, "z-order incremental refresh bypassed the lazy scan"
+        assert max(calls) == 1  # bounded: one file per materialize call
+        session.index_manager.clear_cache()
+        df = session.read.parquet(wide_parquet)
+        q = lambda d: d.filter((d["k"] >= 100) & (d["k"] < 140)).select("k", "v")
+        session.disable_hyperspace()
+        base = q(df).collect()
+        session.enable_hyperspace()
+        got = q(df).collect()
+        s = lambda t: t.sort_by([(c, "ascending") for c in t.column_names])
+        assert s(got).equals(s(base))
+
+    def test_composite_scan_preserves_order_and_columns(self, tmp_path):
+        from hyperspace_tpu.indexes.covering_build import CompositeScan
+
+        d = tmp_path / "cs"
+        d.mkdir()
+        pq.write_table(
+            pa.table({"k": pa.array([1, 2], type=pa.int64()),
+                      "v": pa.array([0.1, 0.2])}),
+            str(d / "a.parquet"),
+        )
+        pq.write_table(
+            pa.table({"k": pa.array([3], type=pa.int64()),
+                      "v": pa.array([0.3])}),
+            str(d / "b.parquet"),
+        )
+        s1 = SourceScan(
+            files=(str(d / "a.parquet"),), fmt="parquet",
+            columns=("k", "v"), file_ids=None, select_cols=("k", "v"),
+        )
+        s2 = SourceScan(
+            files=(str(d / "b.parquet"),), fmt="parquet",
+            columns=("k", "v"), file_ids=None, select_cols=("k", "v"),
+        )
+        cs = CompositeScan((s1, s2))
+        assert cs.files == s1.files + s2.files
+        full = cs.materialize()
+        assert full.column("k").values.tolist() == [1, 2, 3]
+        sub = cs.materialize([str(d / "b.parquet")])
+        assert sub.column("k").values.tolist() == [3]
+        stats = cs.stats_view(["k"])
+        assert stats.materialize().column_names == ["k"]
