@@ -546,6 +546,11 @@ def _pushable_literal(value, arrow_type):
     if isinstance(value, (np.integer, np.floating, np.bool_)):
         value = value.item()
     if pa.types.is_temporal(arrow_type):
+        if pa.types.is_duration(arrow_type):
+            # duration filters are not pushed: arrow's scalar coercion for
+            # timedelta literals does not mirror the engine's tick
+            # lowering; skipping pushdown is always superset-safe
+            return None
         if getattr(arrow_type, "tz", None) is not None:
             # tz-aware columns: arrow refuses naive-vs-aware comparisons
             return None

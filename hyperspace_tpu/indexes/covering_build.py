@@ -33,6 +33,7 @@ the previous index data minus deleted-lineage rows.
 from __future__ import annotations
 
 import dataclasses
+import time as _time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -301,6 +302,7 @@ def prepare_covering_index(ctx, source_df, config, properties: Dict[str, str]):
     scan further before any row is read)."""
     from hyperspace_tpu.indexes.covering import CoveringIndex
 
+    reset_build_breakdown()
     rel = _single_relation(source_df)
     indexed, included, lineage, schema_json = resolve_index_schema(
         rel, config, properties
@@ -333,6 +335,26 @@ def prepare_covering_index(ctx, source_df, config, properties: Dict[str, str]):
     return index, scan
 
 
+# Per-stage wall times of the most recent build (scan/hash/sort/write),
+# reset at each create/refresh data op — the bench publishes these so the
+# throughput story names its bottleneck (SURVEY §7 hard part #4: measure
+# before moving parquet decode on-device).
+last_build_breakdown: Dict[str, float] = {}
+
+
+def _stage_add(name: str, t0: float) -> None:
+    last_build_breakdown[name] = (
+        last_build_breakdown.get(name, 0.0) + _time.perf_counter() - t0
+    )
+
+
+def reset_build_breakdown() -> None:
+    """Called at the entry of every data op (create via
+    prepare_covering_index; refresh/optimize call it directly) so the
+    breakdown never mixes two ops' stage times."""
+    last_build_breakdown.clear()
+
+
 def lazy_or_materialized(ctx, scan):
     """THE build memory-budget rule, in one place: keep the scan lazy
     (streamed at write time through the wave loop) when its estimated
@@ -341,7 +363,10 @@ def lazy_or_materialized(ctx, scan):
     budget = ctx.session.conf.build_memory_budget
     if budget and scan.estimated_bytes() > budget:
         return scan
-    return scan.materialize()
+    t0 = _time.perf_counter()
+    out = scan.materialize()
+    _stage_add("scan", t0)
+    return out
 
 
 def previous_index_scan(
@@ -429,6 +454,7 @@ def _reassemble(spec, arrays: List[np.ndarray]) -> ColumnarBatch:
 def bucketize(ctx, batch: ColumnarBatch, indexed_cols: List[str], num_buckets: int):
     """Route rows to buckets -> (bucket_ids, batch) in bucket-grouped,
     key-sorted order. Uses the mesh all-to-all when >1 device."""
+    t0 = _time.perf_counter()
     reps = batch.key_reps(indexed_cols)
     mesh = ctx.mesh
     if mesh.devices.size > 1 and batch.num_rows >= mesh.devices.size:
@@ -443,8 +469,12 @@ def bucketize(ctx, batch: ColumnarBatch, indexed_cols: List[str], num_buckets: i
         batch = _reassemble(spec, moved[k:])
     else:
         buckets = bucket_ids_np(reps, num_buckets)
+    _stage_add("hash_shuffle", t0)
+    t0 = _time.perf_counter()
     perm = sort_permutation(reps, buckets)
-    return buckets[perm], batch.take(perm)
+    out = buckets[perm], batch.take(perm)
+    _stage_add("sort", t0)
+    return out
 
 
 def write_bucketed(
@@ -473,9 +503,12 @@ def write_bucketed(
         os.makedirs(ctx.index_data_path, exist_ok=True)
         return []
     buckets, batch = bucketize(ctx, batch, indexed_cols, num_buckets)
-    return pio.write_bucket_files(
+    t0 = _time.perf_counter()
+    out = pio.write_bucket_files(
         ctx.index_data_path, buckets, batch, num_buckets, file_idx_offset
     )
+    _stage_add("write", t0)
+    return out
 
 
 def _write_bucketed_streaming(
@@ -591,6 +624,7 @@ def refresh_incremental(
       new version dir.
     Returns (index, UpdateMode.MERGE | OVERWRITE).
     """
+    reset_build_breakdown()
     schema_cols = list(index.indexed_columns) + list(index.included_columns)
     if index.lineage_enabled:
         schema_cols.append(DATA_FILE_NAME_ID)

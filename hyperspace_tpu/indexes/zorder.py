@@ -122,8 +122,10 @@ class ZOrderCoveringIndex(Index):
             lazy_or_materialized,
             prepare_covering_index,
             previous_index_scan,
+            reset_build_breakdown,
         )
 
+        reset_build_breakdown()
         schema_cols = self._indexed_columns + self._included_columns
         if self.lineage_enabled:
             schema_cols = schema_cols + [DATA_FILE_NAME_ID]

@@ -151,7 +151,9 @@ class Column:
         vals = arr.to_numpy(zero_copy_only=False)
         if vals.dtype == object:
             vals = vals.astype(_numpy_dtype_for(t))
-        if vals.dtype.kind == "M":  # datetime64 → int64 for device friendliness
+        if vals.dtype.kind in "Mm":
+            # datetime64 AND timedelta64 → int64 for device friendliness
+            # (durations compare/lower through the same int64-tick path)
             vals = vals.view(np.int64)
         return Column("numeric", t, values=vals, validity=validity)
 
@@ -185,8 +187,14 @@ class Column:
         vals = self.values
         mask = None if self.validity is None else ~self.validity
         t = self.arrow_type
-        if pa.types.is_timestamp(t) or pa.types.is_date(t) or pa.types.is_time(t):
-            # stored as int64 epoch units; 32-bit temporal types cast via int32
+        if (
+            pa.types.is_timestamp(t)
+            or pa.types.is_date(t)
+            or pa.types.is_time(t)
+            or pa.types.is_duration(t)
+        ):
+            # stored as int64 epoch/tick units; 32-bit temporal types cast
+            # via int32
             width = 32 if t in (pa.date32(), pa.time32("s"), pa.time32("ms")) else 64
             itype = pa.int32() if width == 32 else pa.int64()
             ivals = vals.astype(np.int32) if width == 32 else vals

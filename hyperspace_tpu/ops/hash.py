@@ -95,10 +95,13 @@ def _bucket_ids_words(words, num_buckets: int, seed: int):
 # Below this row count the hash runs as plain numpy: the mix functions
 # are dtype-generic (np.uint32 arithmetic works identically on numpy and
 # jnp arrays — bit-exact by construction), and a device dispatch costs a
-# host->device->host round trip that dwarfs the arithmetic for small
-# inputs (measured ~64ms to hash ONE bucket-pruning literal through the
-# device vs microseconds on host).
-_HOST_HASH_MAX_ROWS = 1 << 16
+# host->device->host round trip that dwarfs the arithmetic for
+# HOST-RESIDENT inputs (measured ~64ms to hash ONE bucket-pruning
+# literal, and — bench chip via tunnel, round 5 — 3.4s device vs 0.15s
+# host at 4M rows: transfer dominates at every practical size). The
+# device kernel's home is HBM-resident data on a sharded mesh
+# (parallel/shuffle.py), not host-resident builds.
+_HOST_HASH_MAX_ROWS = 1 << 26
 
 
 def bucket_ids_host(
@@ -137,3 +140,62 @@ def bucket_ids_np(key_reps: np.ndarray, num_buckets: int, seed: int = 42) -> np.
         )
     out = np.asarray(_bucket_ids_words(jnp.asarray(words), num_buckets, seed))
     return out[:n]
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel (HBM-resident regime)
+# ---------------------------------------------------------------------------
+
+# VPU tile for the Pallas grid: each grid step hashes a block of
+# (_PALLAS_BLOCK_ROWS x 128 lanes) elements per word plane.
+_PALLAS_BLOCK_ROWS = 8 * 64  # x 128 lanes = 64Ki elements per grid step
+_PALLAS_BLOCK_N = _PALLAS_BLOCK_ROWS * 128
+
+
+def bucket_ids_pallas(words, num_buckets: int, seed: int = 42):
+    """Pallas twin of ``_bucket_ids_words`` for HBM-RESIDENT word planes.
+
+    Same arithmetic as the XLA kernel (the ``_mix_*``/``_fmix`` helpers
+    are dtype-generic), hand-tiled over the VPU in (sublane, lane) blocks:
+    each grid step hashes a (2k, _PALLAS_BLOCK_ROWS, 128) block of the
+    interleaved uint32 word planes. Input ``words`` is a device array
+    [2k, n] with n a multiple of ``_PALLAS_BLOCK_N`` (callers pad; pad
+    lanes produce garbage buckets that are sliced off). Measured A/B vs
+    the XLA kernel in BASELINE.md — on host-resident data neither
+    matters (transfer dominates; the numpy twin wins), so this kernel's
+    home is mesh-sharded HBM-resident data. Falls back to interpreter
+    mode off-TPU (tests run on CPU).
+    """
+    import jax.experimental.pallas as pl
+
+    m, n = words.shape
+    assert n % _PALLAS_BLOCK_N == 0, (n, _PALLAS_BLOCK_N)
+    rows = n // 128
+    w3 = words.reshape(m, rows, 128)
+
+    def kernel(words_ref, out_ref):
+        h = jnp.full(out_ref.shape, jnp.uint32(seed))
+        for i in range(m):
+            h = _mix_h1(h, _mix_k1(words_ref[i]))
+        h = _fmix(h, jnp.uint32(4 * m))
+        out_ref[...] = (h % jnp.uint32(num_buckets)).astype(jnp.int32)
+
+    grid = (rows // _PALLAS_BLOCK_ROWS,)
+    # trace under x64 DISABLED: the package-wide jax_enable_x64 makes the
+    # BlockSpec index maps produce i64 grid indices, which this Mosaic
+    # rejects ("failed to legalize 'func.return'" on (i64, i32)); the
+    # kernel itself is pure uint32/int32
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((m, _PALLAS_BLOCK_ROWS, 128), lambda i: (0, i, 0))
+            ],
+            out_specs=pl.BlockSpec(
+                (_PALLAS_BLOCK_ROWS, 128), lambda i: (i, 0)
+            ),
+            out_shape=jax.ShapeDtypeStruct((rows, 128), jnp.int32),
+            interpret=jax.devices()[0].platform != "tpu",
+        )(w3)
+    return out.reshape(n)

@@ -234,7 +234,9 @@ def lower_literal(value, arrow_type, op: Optional[str] = None):
     if unit is None:
         if pa.types.is_time(arrow_type):
             return _lower_time_literal(value, arrow_type, op)
-        return value  # duration types: untouched (pre-existing path)
+        if pa.types.is_duration(arrow_type):
+            return _lower_duration_literal(value, arrow_type, op)
+        return value  # interval types beyond duration: untouched
     dt64 = _as_datetime64(value)
     if dt64 is None:
         return None
@@ -246,26 +248,10 @@ def lower_literal(value, arrow_type, op: Optional[str] = None):
     if src_unit in ("Y", "M", "W"):
         dt64 = dt64.astype("datetime64[D]")  # exact calendar conversion
         src_unit = "D"
-    ns_per = {
-        "D": 86_400_000_000_000,
-        "h": 3_600_000_000_000,
-        "m": 60_000_000_000,
-        "s": 1_000_000_000,
-        "ms": 1_000_000,
-        "us": 1_000,
-        "ns": 1,
-    }
-    if src_unit not in ns_per:
+    if src_unit not in _NS_PER:
         return None  # sub-ns units (ps/fs/as): beyond engine precision
-    v_ns = int(dt64.view("int64")) * ns_per[src_unit]
-    q = _snap_between_tick(*divmod(v_ns, ns_per[unit]), op)
-    if q is None:
-        return None
-    if q > np.iinfo(np.int64).max:
-        return np.float64("inf")
-    if q < np.iinfo(np.int64).min:
-        return np.float64("-inf")
-    return np.int64(q)
+    v_ns = int(dt64.view("int64")) * _NS_PER[src_unit]
+    return _clamp_ticks(_snap_between_tick(*divmod(v_ns, _NS_PER[unit]), op))
 
 
 def _snap_between_tick(q, r, op):
@@ -282,6 +268,34 @@ def _snap_between_tick(q, r, op):
     if op in ("<=", ">"):
         return q
     return None
+
+
+# Nanoseconds per fixed-length unit — ONE table shared by every temporal
+# lowering path (datetime, time-of-day, duration). Calendar units (Y/M)
+# are deliberately absent: they have no fixed length.
+_NS_PER = {
+    "W": 604_800_000_000_000,
+    "D": 86_400_000_000_000,
+    "h": 3_600_000_000_000,
+    "m": 60_000_000_000,
+    "s": 1_000_000_000,
+    "ms": 1_000_000,
+    "us": 1_000,
+    "ns": 1,
+}
+
+
+def _clamp_ticks(q):
+    """Snap-result -> engine literal: int64 ticks, or ±inf when the exact
+    tick count overflows int64 (ordering against ±inf stays correct;
+    equality is False). Shared by the datetime and duration paths."""
+    if q is None:
+        return None
+    if q > np.iinfo(np.int64).max:
+        return np.float64("inf")
+    if q < np.iinfo(np.int64).min:
+        return np.float64("-inf")
+    return np.int64(q)
 
 
 def _lower_time_literal(value, arrow_type, op):
@@ -305,8 +319,7 @@ def _lower_time_literal(value, arrow_type, op):
         ((value.hour * 60 + value.minute) * 60 + value.second) * 10**9
         + value.microsecond * 1000
     )
-    per = {"s": 10**9, "ms": 10**6, "us": 10**3, "ns": 1}[arrow_type.unit]
-    q = _snap_between_tick(*divmod(ns, per), op)
+    q = _snap_between_tick(*divmod(ns, _NS_PER[arrow_type.unit]), op)
     return None if q is None else np.int64(q)
 
 
@@ -341,6 +354,43 @@ def _as_datetime64(value):
     if isinstance(value, _dt.date):
         return np.datetime64(value, "D")
     return None
+
+
+def _duration_ns(value):
+    """Exact nanosecond count of a duration literal as a python int
+    (arbitrary precision — overflow must clamp, never wrap), or None for
+    anything that is not a fixed-length duration. Calendar-length numpy
+    units (Y/M) have no fixed nanosecond value and return None, matching
+    numpy's own refusal to compare them against fixed units."""
+    import datetime as _dt
+
+    if isinstance(value, np.timedelta64):
+        if np.isnat(value):
+            return None  # NaT comparisons are never true (numpy/pyarrow)
+        unit = np.datetime_data(value.dtype)[0]
+        if unit not in _NS_PER:
+            return None  # Y/M (calendar) or sub-ns precision
+        return int(value.view("int64")) * _NS_PER[unit]
+    if isinstance(value, _dt.timedelta):
+        # python timedelta is exact at microsecond resolution
+        return (
+            (value.days * 86_400_000_000 + value.seconds * 1_000_000)
+            + value.microseconds
+        ) * 1_000
+    return None
+
+
+def _lower_duration_literal(value, arrow_type, op):
+    """int64 ticks of the duration column's storage unit (io/columnar
+    views timedelta64 as int64), with the same between-tick snapping and
+    ±inf overflow clamping as datetime lowering. The reference gets
+    interval casts from Catalyst; here the literal is lowered through
+    exact python-int arithmetic."""
+    ns = _duration_ns(value)
+    if ns is None:
+        return None
+    q = _snap_between_tick(*divmod(ns, _NS_PER[arrow_type.unit]), op)
+    return _clamp_ticks(q)
 
 
 def normalize_temporal_literal(value, arrow_type):

@@ -1,0 +1,64 @@
+"""A/B: Pallas vs XLA murmur3 bucket-hash kernel on DEVICE-RESIDENT data.
+
+The honest frame for the Pallas question (BASELINE.md): on this one-chip
+setup every build/serve batch is host-resident and transfer dominates, so
+the numpy twin wins regardless of kernel quality. This measures the
+kernels where they actually live — inputs already in HBM, outputs left in
+HBM — i.e. the mesh-sharded multi-chip regime's per-shard work.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.ops.hash import (
+    _PALLAS_BLOCK_N,
+    _bucket_ids_words,
+    bucket_ids_host,
+    bucket_ids_pallas,
+    split_words_np,
+)
+
+
+def bench(fn, *args, reps=20):
+    fn(*args).block_until_ready()  # compile + warm
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn(*args).block_until_ready()
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def main():
+    print(f"platform: {jax.devices()[0].platform}", file=sys.stderr)
+    pallas_jit = jax.jit(
+        bucket_ids_pallas, static_argnames=("num_buckets", "seed")
+    )
+    for n_m in (4, 16, 64):
+        n = n_m * 1024 * 1024  # multiple of _PALLAS_BLOCK_N (64Ki)
+        assert n % _PALLAS_BLOCK_N == 0
+        rng = np.random.default_rng(7)
+        reps = rng.integers(-(2**62), 2**62, (1, n)).astype(np.int64)
+        words = jnp.asarray(split_words_np(reps))  # device-resident input
+        t_xla = bench(_bucket_ids_words, words, 8, 42)
+        t_pallas = bench(pallas_jit, words, 8, 42)
+        ok = np.array_equal(
+            np.asarray(bucket_ids_pallas(words, 8)),
+            bucket_ids_host(reps, 8),
+        )
+        gbps = n * 8 / t_pallas / 1e9
+        print(
+            f"n={n_m}Mi  xla={t_xla*1e3:8.3f}ms  pallas={t_pallas*1e3:8.3f}ms  "
+            f"ratio={t_xla/t_pallas:5.2f}x  pallas_bw={gbps:6.1f}GB/s  exact={ok}"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -26,6 +26,8 @@ def _random_table(rng, n=500):
     flt_nan = rng.random(n) < 0.05
     flts[flt_nan] = np.nan
     strs = rng.choice(["aa", "bb", "cc", "dd", None], n, p=[0.3, 0.3, 0.2, 0.1, 0.1])
+    durs = rng.integers(-5000, 5000, n)  # milliseconds
+    dur_nulls = rng.random(n) < 0.1
     return pa.table(
         {
             "i": pa.array(
@@ -34,6 +36,10 @@ def _random_table(rng, n=500):
             ),
             "f": pa.array(flts),
             "s": pa.array([s if s is None else str(s) for s in strs]),
+            "d": pa.array(
+                [None if m else int(v) for v, m in zip(durs, dur_nulls)],
+                type=pa.duration("ms"),
+            ),
         }
     )
 
@@ -41,9 +47,9 @@ def _random_table(rng, n=500):
 def _random_pred(rng, depth=0):
     """(our Expr, pyarrow compute expr) pair with identical semantics."""
     kind = rng.choice(
-        ["cmp_i", "cmp_f", "eq_s", "in_i", "isnull", "and", "or", "not"]
+        ["cmp_i", "cmp_f", "eq_s", "in_i", "isnull", "cmp_d", "and", "or", "not"]
         if depth < 3
-        else ["cmp_i", "cmp_f", "eq_s", "in_i", "isnull"]
+        else ["cmp_i", "cmp_f", "eq_s", "in_i", "isnull", "cmp_d"]
     )
     f = pc.field
     if kind == "cmp_i":
@@ -75,9 +81,36 @@ def _random_pred(rng, depth=0):
     if kind == "eq_s":
         lit = str(rng.choice(["aa", "bb", "zz"]))
         return E.Col("s") == lit, f("s") == lit
+    if kind == "cmp_d":
+        # duration literal at a RANDOM unit — coarser (s), matching (ms)
+        # or finer (us, possibly between the column's ms ticks): the
+        # engine's tick lowering must agree with pyarrow's exact
+        # duration comparison in every case
+        unit = str(rng.choice(["s", "ms", "us"]))
+        scale = {"s": 5, "ms": 5000, "us": 5_000_500}[unit]
+        lit = np.timedelta64(int(rng.integers(-scale, scale)), unit)
+        op = rng.choice(["==", "<", ">="])
+        ours = {
+            "==": E.Col("d") == lit,
+            "<": E.Col("d") < lit,
+            ">=": E.Col("d") >= lit,
+        }[op]
+        sc = pa.scalar(lit)
+        theirs = {
+            "==": f("d") == sc,
+            "<": f("d") < sc,
+            ">=": f("d") >= sc,
+        }[op]
+        return ours, theirs
     if kind == "in_i":
         vals = [int(v) for v in rng.integers(-60, 60, 3)]
-        return E.Col("i").isin(*vals), f("i").isin(vals)
+        # oracle NOTE: pyarrow's is_in maps NULL to false (so NOT IN would
+        # wrongly keep null rows); SQL three-valued IN ≡ an OR-chain of
+        # equalities, through which NULL propagates correctly
+        theirs = f("i") == vals[0]
+        for v in vals[1:]:
+            theirs = theirs | (f("i") == v)
+        return E.Col("i").isin(*vals), theirs
     if kind == "isnull":
         col = str(rng.choice(["i", "s"]))
         return E.IsNull(E.Col(col)), f(col).is_null()

@@ -569,3 +569,104 @@ class TestRowLevelPushdownSuperset:
             lambda df: df.filter(df["k"] == 2**63).select("k"),
         )
         assert out.num_rows == 0
+
+
+class TestDurationLiterals:
+    """Duration (interval) literal lowering — round-5 closure of the known
+    predicate hole (reference: Catalyst's interval casts)."""
+
+    def _table(self):
+        return pa.table(
+            {
+                "d": pa.array(
+                    np.array([1000, 2500, -3000, 0], dtype="timedelta64[ms]")
+                ),
+                "v": pa.array([1, 2, 3, 4], pa.int64()),
+            }
+        )
+
+    def _q(self, session, tmp_path, q):
+        d = tmp_path / "dur"
+        d.mkdir(exist_ok=True)
+        pq.write_table(self._table(), d / "a.parquet")
+        return q(session.read.parquet(str(d))).collect()
+
+    def test_matching_unit_equality(self, session, tmp_path):
+        out = self._q(
+            session, tmp_path,
+            lambda df: df.filter(
+                df["d"] == np.timedelta64(2500, "ms")
+            ).select("v"),
+        )
+        assert out.column("v").to_pylist() == [2]
+
+    def test_finer_unit_between_ticks(self, session, tmp_path):
+        # 2500500us is between ms ticks: equality never matches; the range
+        # comparison keeps exactly the values strictly below it
+        lit = np.timedelta64(2_500_500, "us")
+        eq = self._q(
+            session, tmp_path,
+            lambda df: df.filter(df["d"] == lit).select("v"),
+        )
+        assert eq.num_rows == 0
+        lt = self._q(
+            session, tmp_path,
+            lambda df: df.filter(df["d"] < lit).select("v"),
+        )
+        assert sorted(lt.column("v").to_pylist()) == [1, 2, 3, 4]
+
+    def test_python_timedelta_and_negative(self, session, tmp_path):
+        import datetime
+
+        out = self._q(
+            session, tmp_path,
+            lambda df: df.filter(
+                df["d"] < datetime.timedelta(seconds=0)
+            ).select("v"),
+        )
+        assert out.column("v").to_pylist() == [3]
+
+    def test_calendar_units_never_match(self, session, tmp_path):
+        # numpy Y/M timedeltas are calendar-length (no fixed ns value):
+        # the engine refuses them — equality never matches
+        out = self._q(
+            session, tmp_path,
+            lambda df: df.filter(
+                df["d"] == np.timedelta64(1, "M")
+            ).select("v"),
+        )
+        assert out.num_rows == 0
+
+    def test_overflow_clamps_not_wraps(self, session, tmp_path):
+        # a duration beyond int64 ticks of the COLUMN unit must clamp to
+        # +inf (all rows compare smaller), never wrap negative: 9e15 days
+        # = 7.8e23 ms >> int64 max (9.2e18)
+        big = np.timedelta64(9_000_000_000_000_000, "D")
+        out = self._q(
+            session, tmp_path,
+            lambda df: df.filter(df["d"] < big).select("v"),
+        )
+        assert out.num_rows == 4
+
+    def test_duration_roundtrip_to_arrow(self):
+        from hyperspace_tpu.io.columnar import ColumnarBatch
+
+        t = self._table()
+        assert ColumnarBatch.from_arrow(t).to_arrow().equals(t)
+
+    def test_duration_filters_not_pushed(self):
+        from hyperspace_tpu.execution.executor import _pushable_literal
+
+        assert _pushable_literal(np.timedelta64(1, "s"), pa.duration("ms")) is None
+
+    def test_nat_duration_never_matches(self, session, tmp_path):
+        # NaT's int64 view is int64-min; treating it as a tick count would
+        # make >= NaT match every row — numpy/pyarrow both say none
+        nat = np.timedelta64("NaT", "ms")
+        for q in (
+            lambda df: df.filter(df["d"] >= nat).select("v"),
+            lambda df: df.filter(df["d"] == nat).select("v"),
+            lambda df: df.filter(df["d"] < nat).select("v"),
+        ):
+            out = self._q(session, tmp_path, q)
+            assert out.num_rows == 0
