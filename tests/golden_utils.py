@@ -1,0 +1,43 @@
+"""Shared golden-file machinery for the plan-stability suites.
+
+One copy of the reference's SPARK_GENERATE_GOLDEN_FILES protocol
+(``goldstandard/PlanStabilitySuite.scala:46-290``): plan simplification
+(paths and log versions normalized so plans are stable across machines
+and reruns), regenerate-on-flag, and the compare-with-diff assertion.
+Used by ``test_plan_stability.py`` (TPC-H-mini) and
+``test_tpch_plan_stability.py`` (the 22-query TPC-H corpus).
+"""
+
+import os
+import re
+
+GENERATE = os.environ.get("HS_GENERATE_GOLDEN_FILES") == "1"
+
+
+def simplify_plan(plan_str: str, root: str) -> str:
+    """Path- and version-independent plan text."""
+    s = plan_str.replace(root, "<tpch>")
+    s = re.sub(r"LogVersion: \d+", "LogVersion: N", s)
+    s = re.sub(r"/[^ \[\]]*/indexes", "<system>", s)
+    return s + "\n"
+
+
+def check_or_generate(golden_path: str, got: str, name: str):
+    """Compare against the approved plan, or (re)write it under the
+    HS_GENERATE_GOLDEN_FILES=1 flow. Returns True when the file was
+    regenerated (caller skips the test)."""
+    if GENERATE:
+        os.makedirs(os.path.dirname(golden_path), exist_ok=True)
+        with open(golden_path, "w") as f:
+            f.write(got)
+        return True
+    assert os.path.exists(golden_path), (
+        f"Missing golden file {golden_path}; run with HS_GENERATE_GOLDEN_FILES=1"
+    )
+    with open(golden_path) as f:
+        want = f.read()
+    assert got == want, (
+        f"Plan changed for {name}.\n--- approved ---\n{want}\n--- got ---\n{got}\n"
+        "If intentional, regenerate with HS_GENERATE_GOLDEN_FILES=1 and review."
+    )
+    return False

@@ -148,12 +148,9 @@ def _queries(t):
 
 
 def simplify(plan_str: str, root: str) -> str:
-    """Path- and version-independent plan text (the reference's
-    'simplified plan': stable across machines and reruns)."""
-    s = plan_str.replace(root, "<tpch>")
-    s = re.sub(r"LogVersion: \d+", "LogVersion: N", s)
-    s = re.sub(r"/[^ \[\]]*/indexes", "<system>", s)
-    return s + "\n"
+    from golden_utils import simplify_plan
+
+    return simplify_plan(plan_str, root)
 
 
 QUERY_NAMES = [
@@ -174,20 +171,10 @@ def test_plan_stability(qname, session, tpch):
     df = queries[qname]
     got = simplify(session.optimize(df.logical_plan).pretty(), tpch["root"])
     golden_path = os.path.join(GOLDEN_DIR, f"{qname}.txt")
-    if GENERATE:
-        os.makedirs(GOLDEN_DIR, exist_ok=True)
-        with open(golden_path, "w") as f:
-            f.write(got)
+    from golden_utils import check_or_generate
+
+    if check_or_generate(golden_path, got, qname):
         pytest.skip("golden file regenerated")
-    assert os.path.exists(golden_path), (
-        f"Missing golden file {golden_path}; run with HS_GENERATE_GOLDEN_FILES=1"
-    )
-    with open(golden_path) as f:
-        want = f.read()
-    assert got == want, (
-        f"Plan changed for {qname}.\n--- approved ---\n{want}\n--- got ---\n{got}\n"
-        "If intentional, regenerate with HS_GENERATE_GOLDEN_FILES=1 and review."
-    )
     # the plan must also EXECUTE and match the unindexed answer
     with_idx = df.collect()
     session.disable_hyperspace()

@@ -1,0 +1,592 @@
+"""TPC-H (22 queries) plan-stability golden harness, via the SQL surface.
+
+The reference checks in all 103 TPC-DS queries and snapshots simplified
+physical plans, failing CI on any plan change
+(``goldstandard/PlanStabilitySuite.scala:46-290``). This is the same
+machinery at real TPC-H breadth: a deterministic 8-table TPC-H-shaped
+dataset (SF ~0.01 row counts), a fixed index inventory, and all 22
+queries expressed in the engine's SQL dialect. Golden files contain the
+simplified optimized plan WITH indexes and WITHOUT (both sections), and
+each query is additionally executed differentially (indexed answer ==
+unindexed answer).
+
+Dialect adaptations (the engine's SQL has no subqueries, outer joins,
+CASE, LIKE, HAVING, or computed select expressions; adaptations keep
+each query's predicate structure, grouping and ordering, and keep the
+table set/join graph EXCEPT where noted below):
+
+  q2   min-supplycost subquery dropped (join graph + region filter kept)
+  q4   EXISTS -> inner join on l_orderkey (count semantics over matches)
+  q7/q8  nation self-joins use the pre-renamed ``nation2`` view;
+         CASE/year-extraction replaced by plain aggregates
+  q9   REDUCED table set: part/partsupp/supplier/nation2 only (the
+       lineitem/orders legs served the dropped profit expression)
+  q13  LEFT OUTER JOIN -> inner join; count(distinct) -> count
+  q14/q16  LIKE patterns -> equality/IN on the categorical column
+  q11/q15/q18  HAVING / subquery thresholds dropped or made literal
+  q17  0.2*avg(quantity) subquery -> literal quantity threshold
+  q19  OR-of-conjunct structure kept verbatim (brand x quantity bands)
+  q20  REDUCED table set: supplier/nation only (the part/partsupp/
+       lineitem legs existed solely for the nested EXISTS chain)
+  q22  REDUCED table set: customer only (the NOT-EXISTS orders probe
+       and phone-prefix/acctbal subqueries became literal predicates)
+  revenue measures are SUM(l_extendedprice) (no computed expressions)
+
+Regenerate after an intentional planner change with:
+
+    HS_GENERATE_GOLDEN_FILES=1 python -m pytest tests/test_tpch_plan_stability.py
+"""
+
+import os
+import re
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+from hyperspace_tpu.indexes.dataskipping import DataSkippingIndexConfig
+from hyperspace_tpu.indexes.sketches import MinMaxSketch
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "goldstandard", "tpch")
+
+# SF ~0.01 row counts
+N_REGION, N_NATION, N_SUPP = 5, 25, 100
+N_CUST, N_PART, N_PARTSUPP = 1500, 2000, 8000
+N_ORDERS, N_LINEITEM = 15000, 60000
+
+_SEGMENTS = ["BUILDING", "MACHINERY", "AUTOMOBILE", "HOUSEHOLD", "FURNITURE"]
+_NATIONS = [
+    "ALGERIA", "ARGENTINA", "BRAZIL", "CANADA", "EGYPT", "ETHIOPIA",
+    "FRANCE", "GERMANY", "INDIA", "INDONESIA", "IRAN", "IRAQ", "JAPAN",
+    "JORDAN", "KENYA", "MOROCCO", "MOZAMBIQUE", "PERU", "CHINA",
+    "ROMANIA", "SAUDI ARABIA", "VIETNAM", "RUSSIA", "UNITED KINGDOM",
+    "UNITED STATES",
+]
+_REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+_BRANDS = [f"Brand#{i}{j}" for i in range(1, 6) for j in range(1, 6)]
+_TYPES = ["PROMO", "STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY"]
+_CONTAINERS = ["SM CASE", "MED BOX", "LG DRUM", "JUMBO PACK"]
+_MODES = ["MAIL", "SHIP", "AIR", "TRUCK", "RAIL", "FOB", "REG AIR"]
+_PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+
+
+def _gen_tpch(root):
+    """Deterministic TPC-H-shaped tables."""
+    rng = np.random.default_rng(22)
+    day = lambda s: np.datetime64(s)
+
+    def dates(base, spread, n):
+        return (
+            day(base) + rng.integers(0, spread, n).astype("timedelta64[D]")
+        ).astype("datetime64[D]")
+
+    region = pa.table(
+        {
+            "r_regionkey": pa.array(np.arange(N_REGION), pa.int64()),
+            "r_name": pa.array(_REGIONS),
+        }
+    )
+    nation_cols = {
+        "n_nationkey": np.arange(N_NATION, dtype=np.int64),
+        "n_name": _NATIONS,
+        "n_regionkey": (np.arange(N_NATION) % N_REGION).astype(np.int64),
+    }
+    nation = pa.table(nation_cols)
+    # pre-renamed copy for self-join queries (q7/q8/q9)
+    nation2 = pa.table(
+        {
+            "n2_nationkey": nation_cols["n_nationkey"],
+            "n2_name": nation_cols["n_name"],
+            "n2_regionkey": nation_cols["n_regionkey"],
+        }
+    )
+    supplier = pa.table(
+        {
+            "s_suppkey": pa.array(np.arange(N_SUPP), pa.int64()),
+            "s_name": pa.array([f"Supplier#{i:09d}" for i in range(N_SUPP)]),
+            "s_nationkey": pa.array(
+                rng.integers(0, N_NATION, N_SUPP), pa.int64()
+            ),
+            "s_acctbal": pa.array(np.round(rng.uniform(-999, 9999, N_SUPP), 2)),
+        }
+    )
+    customer = pa.table(
+        {
+            "c_custkey": pa.array(np.arange(N_CUST), pa.int64()),
+            "c_name": pa.array([f"Customer#{i:09d}" for i in range(N_CUST)]),
+            "c_nationkey": pa.array(
+                rng.integers(0, N_NATION, N_CUST), pa.int64()
+            ),
+            "c_mktsegment": pa.array(
+                [_SEGMENTS[i % len(_SEGMENTS)] for i in range(N_CUST)]
+            ),
+            "c_acctbal": pa.array(np.round(rng.uniform(-999, 9999, N_CUST), 2)),
+        }
+    )
+    part = pa.table(
+        {
+            "p_partkey": pa.array(np.arange(N_PART), pa.int64()),
+            "p_brand": pa.array(
+                [_BRANDS[i % len(_BRANDS)] for i in range(N_PART)]
+            ),
+            "p_type": pa.array(
+                [_TYPES[i % len(_TYPES)] for i in range(N_PART)]
+            ),
+            "p_size": pa.array(
+                rng.integers(1, 51, N_PART), pa.int64()
+            ),
+            "p_container": pa.array(
+                [_CONTAINERS[i % len(_CONTAINERS)] for i in range(N_PART)]
+            ),
+            "p_retailprice": pa.array(np.round(rng.uniform(900, 2000, N_PART), 2)),
+        }
+    )
+    partsupp = pa.table(
+        {
+            "ps_partkey": pa.array(
+                np.repeat(np.arange(N_PART), N_PARTSUPP // N_PART), pa.int64()
+            ),
+            "ps_suppkey": pa.array(
+                rng.integers(0, N_SUPP, N_PARTSUPP), pa.int64()
+            ),
+            "ps_availqty": pa.array(
+                rng.integers(1, 10000, N_PARTSUPP), pa.int64()
+            ),
+            "ps_supplycost": pa.array(
+                np.round(rng.uniform(1, 1000, N_PARTSUPP), 2)
+            ),
+        }
+    )
+    orders = pa.table(
+        {
+            "o_orderkey": pa.array(np.arange(N_ORDERS), pa.int64()),
+            "o_custkey": pa.array(
+                rng.integers(0, N_CUST, N_ORDERS), pa.int64()
+            ),
+            "o_orderstatus": pa.array(
+                [["O", "F", "P"][i % 3] for i in range(N_ORDERS)]
+            ),
+            "o_totalprice": pa.array(
+                np.round(rng.uniform(1000, 450000, N_ORDERS), 2)
+            ),
+            "o_orderdate": pa.array(dates("1992-01-01", 2400, N_ORDERS)),
+            "o_orderpriority": pa.array(
+                [_PRIORITIES[i % len(_PRIORITIES)] for i in range(N_ORDERS)]
+            ),
+        }
+    )
+    ship = dates("1992-01-03", 2400, N_LINEITEM)
+    commit = ship + rng.integers(-30, 60, N_LINEITEM).astype("timedelta64[D]")
+    receipt = ship + rng.integers(1, 45, N_LINEITEM).astype("timedelta64[D]")
+    lineitem = pa.table(
+        {
+            "l_orderkey": pa.array(
+                rng.integers(0, N_ORDERS, N_LINEITEM), pa.int64()
+            ),
+            "l_partkey": pa.array(
+                rng.integers(0, N_PART, N_LINEITEM), pa.int64()
+            ),
+            "l_suppkey": pa.array(
+                rng.integers(0, N_SUPP, N_LINEITEM), pa.int64()
+            ),
+            "l_quantity": pa.array(
+                rng.integers(1, 51, N_LINEITEM), pa.int64()
+            ),
+            "l_extendedprice": pa.array(
+                np.round(rng.uniform(900, 100000, N_LINEITEM), 2)
+            ),
+            "l_discount": pa.array(
+                np.round(rng.uniform(0.0, 0.1, N_LINEITEM), 2)
+            ),
+            "l_returnflag": pa.array(
+                [["R", "A", "N"][i % 3] for i in range(N_LINEITEM)]
+            ),
+            "l_linestatus": pa.array(
+                [["O", "F"][i % 2] for i in range(N_LINEITEM)]
+            ),
+            "l_shipdate": pa.array(ship),
+            "l_commitdate": pa.array(commit.astype("datetime64[D]")),
+            "l_receiptdate": pa.array(receipt.astype("datetime64[D]")),
+            "l_shipmode": pa.array(
+                [_MODES[i % len(_MODES)] for i in range(N_LINEITEM)]
+            ),
+        }
+    )
+    tables = {
+        "region": (region, 1),
+        "nation": (nation, 1),
+        "nation2": (nation2, 1),
+        "supplier": (supplier, 1),
+        "customer": (customer, 2),
+        "part": (part, 2),
+        "partsupp": (partsupp, 2),
+        "orders": (orders, 4),
+        "lineitem": (lineitem, 4),
+    }
+    for name, (table, parts) in tables.items():
+        d = os.path.join(root, name)
+        os.makedirs(d)
+        rows = table.num_rows
+        for i in range(parts):
+            lo, hi = i * rows // parts, (i + 1) * rows // parts
+            pq.write_table(
+                table.slice(lo, hi - lo), os.path.join(d, f"part-{i}.parquet")
+            )
+    return tables
+
+
+@pytest.fixture(scope="module")
+def tpch(tmp_path_factory):
+    """One module-scoped dataset + session + index inventory (plan
+    stability does not need the mesh-size matrix; queries still execute
+    differentially)."""
+    from hyperspace_tpu.session import HyperspaceSession
+
+    root = str(tmp_path_factory.mktemp("tpch"))
+    _gen_tpch(root)
+    session = HyperspaceSession()
+    session.conf.set(C.INDEX_SYSTEM_PATH, os.path.join(root, "_indexes"))
+    session.conf.set(C.INDEX_NUM_BUCKETS, 4)
+    session.conf.set(C.INDEX_FILTER_RULE_USE_BUCKET_SPEC, True)
+    hs = Hyperspace(session)
+    views = {}
+    for name in (
+        "region", "nation", "nation2", "supplier", "customer",
+        "part", "partsupp", "orders", "lineitem",
+    ):
+        df = session.read.parquet(os.path.join(root, name))
+        session.register_view(name, df)
+        views[name] = df
+    li, od, cu = views["lineitem"], views["orders"], views["customer"]
+    pt, ps, sp = views["part"], views["partsupp"], views["supplier"]
+    # fixed index inventory: join keys covered with the payload columns
+    # the 22 queries project; MinMax sketches for the date-range scans
+    hs.create_index(
+        li,
+        CoveringIndexConfig(
+            "li_okey",
+            ["l_orderkey"],
+            ["l_quantity", "l_extendedprice", "l_shipdate", "l_commitdate",
+             "l_receiptdate", "l_shipmode", "l_returnflag"],
+        ),
+    )
+    hs.create_index(
+        li,
+        CoveringIndexConfig(
+            "li_pkey",
+            ["l_partkey"],
+            ["l_quantity", "l_extendedprice", "l_shipdate"],
+        ),
+    )
+    hs.create_index(
+        li,
+        CoveringIndexConfig(
+            "li_skey",
+            ["l_suppkey"],
+            ["l_orderkey", "l_extendedprice", "l_shipdate",
+             "l_receiptdate", "l_commitdate"],
+        ),
+    )
+    hs.create_index(
+        od,
+        CoveringIndexConfig(
+            "od_okey",
+            ["o_orderkey"],
+            ["o_custkey", "o_orderdate", "o_totalprice", "o_orderpriority",
+             "o_orderstatus"],
+        ),
+    )
+    hs.create_index(
+        od,
+        CoveringIndexConfig(
+            "od_ckey",
+            ["o_custkey"],
+            ["o_orderkey", "o_orderdate", "o_totalprice"],
+        ),
+    )
+    hs.create_index(
+        cu,
+        CoveringIndexConfig(
+            "cu_ckey",
+            ["c_custkey"],
+            ["c_name", "c_nationkey", "c_mktsegment", "c_acctbal"],
+        ),
+    )
+    hs.create_index(
+        pt,
+        CoveringIndexConfig(
+            "pt_pkey",
+            ["p_partkey"],
+            ["p_brand", "p_type", "p_size", "p_container"],
+        ),
+    )
+    hs.create_index(
+        ps,
+        CoveringIndexConfig(
+            "ps_pkey", ["ps_partkey"], ["ps_suppkey", "ps_supplycost"]
+        ),
+    )
+    hs.create_index(
+        ps,
+        CoveringIndexConfig(
+            "ps_skey", ["ps_suppkey"], ["ps_partkey", "ps_supplycost"]
+        ),
+    )
+    hs.create_index(
+        sp,
+        CoveringIndexConfig(
+            "sp_skey", ["s_suppkey"], ["s_name", "s_nationkey", "s_acctbal"]
+        ),
+    )
+    hs.create_index(
+        li, DataSkippingIndexConfig("li_ship_sk", MinMaxSketch("l_shipdate"))
+    )
+    hs.create_index(
+        od, DataSkippingIndexConfig("od_date_sk", MinMaxSketch("o_orderdate"))
+    )
+    session.enable_hyperspace()
+    return {"session": session, "root": root}
+
+
+QUERIES = {
+    "q01": """
+        SELECT l_returnflag, l_linestatus, SUM(l_quantity) AS sum_qty,
+               SUM(l_extendedprice) AS sum_price, AVG(l_quantity) AS avg_qty,
+               AVG(l_discount) AS avg_disc, COUNT(*) AS count_order
+        FROM lineitem WHERE l_shipdate <= DATE '1998-09-02'
+        GROUP BY l_returnflag, l_linestatus
+        ORDER BY l_returnflag, l_linestatus""",
+    "q02": """
+        SELECT s_acctbal, s_name, n_name, p_partkey
+        FROM part
+        JOIN partsupp ON p_partkey = ps_partkey
+        JOIN supplier ON ps_suppkey = s_suppkey
+        JOIN nation ON s_nationkey = n_nationkey
+        JOIN region ON n_regionkey = r_regionkey
+        WHERE p_size = 15 AND r_name = 'EUROPE'
+        ORDER BY s_acctbal DESC, n_name, s_name, p_partkey LIMIT 100""",
+    "q03": """
+        SELECT o_orderkey, o_orderdate, SUM(l_extendedprice) AS revenue
+        FROM customer
+        JOIN orders ON c_custkey = o_custkey
+        JOIN lineitem ON o_orderkey = l_orderkey
+        WHERE c_mktsegment = 'BUILDING'
+          AND o_orderdate < DATE '1995-03-15'
+          AND l_shipdate > DATE '1995-03-15'
+        GROUP BY o_orderkey, o_orderdate
+        ORDER BY o_orderkey LIMIT 10""",
+    "q04": """
+        SELECT o_orderpriority, COUNT(*) AS order_count
+        FROM orders
+        JOIN lineitem ON o_orderkey = l_orderkey
+        WHERE o_orderdate >= DATE '1993-07-01'
+          AND o_orderdate < DATE '1993-10-01'
+          AND l_commitdate < l_receiptdate
+        GROUP BY o_orderpriority ORDER BY o_orderpriority""",
+    "q05": """
+        SELECT n_name, SUM(l_extendedprice) AS revenue
+        FROM customer
+        JOIN orders ON c_custkey = o_custkey
+        JOIN lineitem ON o_orderkey = l_orderkey
+        JOIN supplier ON l_suppkey = s_suppkey
+        JOIN nation ON s_nationkey = n_nationkey
+        JOIN region ON n_regionkey = r_regionkey
+        WHERE r_name = 'ASIA'
+          AND o_orderdate >= DATE '1994-01-01'
+          AND o_orderdate < DATE '1995-01-01'
+          AND c_nationkey = s_nationkey
+        GROUP BY n_name ORDER BY n_name""",
+    "q06": """
+        SELECT SUM(l_extendedprice) AS revenue
+        FROM lineitem
+        WHERE l_shipdate >= DATE '1994-01-01'
+          AND l_shipdate < DATE '1995-01-01'
+          AND l_discount BETWEEN 0.05 AND 0.07
+          AND l_quantity < 24""",
+    "q07": """
+        SELECT n_name, n2_name, SUM(l_extendedprice) AS revenue
+        FROM supplier
+        JOIN lineitem ON s_suppkey = l_suppkey
+        JOIN orders ON l_orderkey = o_orderkey
+        JOIN customer ON o_custkey = c_custkey
+        JOIN nation ON s_nationkey = n_nationkey
+        JOIN nation2 ON c_nationkey = n2_nationkey
+        WHERE n_name = 'FRANCE' AND n2_name = 'GERMANY'
+          AND l_shipdate >= DATE '1995-01-01'
+          AND l_shipdate <= DATE '1996-12-31'
+        GROUP BY n_name, n2_name""",
+    "q08": """
+        SELECT n2_name, SUM(l_extendedprice) AS volume
+        FROM part
+        JOIN lineitem ON p_partkey = l_partkey
+        JOIN supplier ON l_suppkey = s_suppkey
+        JOIN orders ON l_orderkey = o_orderkey
+        JOIN customer ON o_custkey = c_custkey
+        JOIN nation ON c_nationkey = n_nationkey
+        JOIN region ON n_regionkey = r_regionkey
+        JOIN nation2 ON s_nationkey = n2_nationkey
+        WHERE r_name = 'AMERICA' AND p_type = 'ECONOMY'
+          AND o_orderdate BETWEEN DATE '1995-01-01' AND DATE '1996-12-31'
+        GROUP BY n2_name ORDER BY n2_name""",
+    "q09": """
+        SELECT n2_name, SUM(ps_supplycost) AS amount
+        FROM part
+        JOIN partsupp ON p_partkey = ps_partkey
+        JOIN supplier ON ps_suppkey = s_suppkey
+        JOIN nation2 ON s_nationkey = n2_nationkey
+        WHERE p_type = 'STANDARD'
+        GROUP BY n2_name ORDER BY n2_name""",
+    "q10": """
+        SELECT c_custkey, c_name, SUM(l_extendedprice) AS revenue
+        FROM customer
+        JOIN orders ON c_custkey = o_custkey
+        JOIN lineitem ON o_orderkey = l_orderkey
+        WHERE o_orderdate >= DATE '1993-10-01'
+          AND o_orderdate < DATE '1994-01-01'
+          AND l_returnflag = 'R'
+        GROUP BY c_custkey, c_name ORDER BY c_custkey LIMIT 20""",
+    "q11": """
+        SELECT ps_partkey, SUM(ps_supplycost) AS value
+        FROM partsupp
+        JOIN supplier ON ps_suppkey = s_suppkey
+        JOIN nation ON s_nationkey = n_nationkey
+        WHERE n_name = 'GERMANY'
+        GROUP BY ps_partkey ORDER BY ps_partkey LIMIT 50""",
+    "q12": """
+        SELECT l_shipmode, COUNT(*) AS line_count
+        FROM orders
+        JOIN lineitem ON o_orderkey = l_orderkey
+        WHERE l_shipmode IN ('MAIL', 'SHIP')
+          AND l_commitdate < l_receiptdate
+          AND l_shipdate < l_commitdate
+          AND l_receiptdate >= DATE '1994-01-01'
+          AND l_receiptdate < DATE '1995-01-01'
+        GROUP BY l_shipmode ORDER BY l_shipmode""",
+    "q13": """
+        SELECT c_custkey, COUNT(*) AS c_count
+        FROM customer
+        JOIN orders ON c_custkey = o_custkey
+        GROUP BY c_custkey ORDER BY c_custkey LIMIT 100""",
+    "q14": """
+        SELECT SUM(l_extendedprice) AS promo_revenue
+        FROM lineitem
+        JOIN part ON l_partkey = p_partkey
+        WHERE l_shipdate >= DATE '1995-09-01'
+          AND l_shipdate < DATE '1995-10-01'
+          AND p_type = 'PROMO'""",
+    "q15": """
+        SELECT l_suppkey, SUM(l_extendedprice) AS total_revenue
+        FROM lineitem
+        WHERE l_shipdate >= DATE '1996-01-01'
+          AND l_shipdate < DATE '1996-04-01'
+        GROUP BY l_suppkey ORDER BY l_suppkey LIMIT 10""",
+    "q16": """
+        SELECT p_brand, p_type, p_size, COUNT(*) AS supplier_cnt
+        FROM partsupp
+        JOIN part ON ps_partkey = p_partkey
+        WHERE p_brand <> 'Brand#45'
+          AND p_size IN (49, 14, 23, 45, 19, 3, 36, 9)
+        GROUP BY p_brand, p_type, p_size
+        ORDER BY p_brand, p_type, p_size LIMIT 40""",
+    "q17": """
+        SELECT SUM(l_extendedprice) AS avg_yearly
+        FROM lineitem
+        JOIN part ON l_partkey = p_partkey
+        WHERE p_brand = 'Brand#23' AND p_container = 'MED BOX'
+          AND l_quantity < 5""",
+    "q18": """
+        SELECT c_custkey, o_orderkey, SUM(l_quantity) AS total_qty
+        FROM customer
+        JOIN orders ON c_custkey = o_custkey
+        JOIN lineitem ON o_orderkey = l_orderkey
+        WHERE o_totalprice > 400000
+        GROUP BY c_custkey, o_orderkey ORDER BY o_orderkey LIMIT 100""",
+    "q19": """
+        SELECT SUM(l_extendedprice) AS revenue
+        FROM lineitem
+        JOIN part ON l_partkey = p_partkey
+        WHERE (p_brand = 'Brand#12' AND l_quantity BETWEEN 1 AND 11)
+           OR (p_brand = 'Brand#23' AND l_quantity BETWEEN 10 AND 20)
+           OR (p_brand = 'Brand#34' AND l_quantity BETWEEN 20 AND 30)""",
+    "q20": """
+        SELECT s_name, s_acctbal
+        FROM supplier
+        JOIN nation ON s_nationkey = n_nationkey
+        WHERE n_name = 'CANADA'
+        ORDER BY s_name""",
+    "q21": """
+        SELECT s_name, COUNT(*) AS numwait
+        FROM supplier
+        JOIN lineitem ON s_suppkey = l_suppkey
+        JOIN orders ON l_orderkey = o_orderkey
+        JOIN nation ON s_nationkey = n_nationkey
+        WHERE o_orderstatus = 'F'
+          AND l_receiptdate > l_commitdate
+          AND n_name = 'SAUDI ARABIA'
+        GROUP BY s_name ORDER BY s_name LIMIT 100""",
+    "q22": """
+        SELECT c_mktsegment, COUNT(*) AS numcust, SUM(c_acctbal) AS totacctbal
+        FROM customer
+        WHERE c_acctbal > 7000
+          AND c_mktsegment IN ('BUILDING', 'MACHINERY', 'AUTOMOBILE')
+        GROUP BY c_mktsegment ORDER BY c_mktsegment""",
+}
+
+
+@pytest.mark.parametrize("qname", sorted(QUERIES))
+def test_tpch_plan_stability(qname, tpch):
+    from golden_utils import check_or_generate, simplify_plan
+
+    session = tpch["session"]
+    root = tpch["root"]
+    df = session.sql(QUERIES[qname])
+    with_idx_plan = simplify_plan(
+        session.optimize(df.logical_plan).pretty(), root
+    )
+    session.disable_hyperspace()
+    try:
+        raw_plan = simplify_plan(
+            session.optimize(df.logical_plan).pretty(), root
+        )
+    finally:
+        session.enable_hyperspace()
+    got = (
+        "=== with indexes ===\n" + with_idx_plan + "\n"
+        "=== without indexes ===\n" + raw_plan + "\n"
+    )
+    golden_path = os.path.join(GOLDEN_DIR, f"{qname}.txt")
+    if check_or_generate(golden_path, got, qname):
+        pytest.skip("golden file regenerated")
+    # differential execution: indexed answer == unindexed answer.
+    # Float SUM/AVG aggregates are compared with tolerance — the index
+    # path feeds rows to the reduction in a different order and double
+    # addition is not associative (exact for every other type).
+    with_idx = df.collect()
+    session.disable_hyperspace()
+    try:
+        base = df.collect()
+    finally:
+        session.enable_hyperspace()
+    key = lambda t: t.sort_by([(c, "ascending") for c in t.column_names])
+    a, b = key(with_idx), key(base)
+    assert a.num_rows == b.num_rows and a.column_names == b.column_names, qname
+    for col in a.column_names:
+        av, bv = a.column(col), b.column(col)
+        if pa.types.is_floating(av.type):
+            assert np.allclose(
+                av.to_numpy(zero_copy_only=False),
+                bv.to_numpy(zero_copy_only=False),
+                rtol=1e-9,
+                equal_nan=True,
+            ), (qname, col)
+        else:
+            assert av.equals(bv), (qname, col)
+
+
+def test_corpus_is_complete():
+    assert len(QUERIES) == 22
+    assert sorted(QUERIES) == [f"q{i:02d}" for i in range(1, 23)]
