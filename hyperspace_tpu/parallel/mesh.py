@@ -1,26 +1,95 @@
-"""Session device mesh.
+"""Session device mesh + multi-host bootstrap.
 
 The reference's execution substrate is a Spark cluster (driver +
 executors); ours is a 1-D ``jax.sharding.Mesh`` over all addressable
 devices — the "executors" are mesh shards, the host Python process is the
-driver. Multi-host scaling is the same code: ``jax.devices()`` spans hosts
-under ``jax.distributed``, collectives ride ICI within a slice and DCN
-across slices.
+driver. Multi-host scaling is the same code: after
+:func:`initialize_distributed`, ``jax.devices()`` spans every host
+(process-major order, so consecutive mesh positions are ICI neighbors
+within a host's chips) and the same ``shard_map`` collectives ride ICI
+within a slice and DCN across hosts. The DCN-aware layout and the
+collective plan for a v5e-64 are documented in ``docs/MULTIHOST.md``;
+``scripts/dryrun_multihost.py`` exercises this bootstrap as 2 real
+processes x 4 CPU devices.
 """
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 
 SHARD_AXIS = "shard"
+# hierarchical mesh axes: DCN (cross-host) outer, ICI (intra-host) inner
+DCN_AXIS = "dcn"
+ICI_AXIS = "ici"
+
+_DISTRIBUTED_INITIALIZED = False
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    cpu_local_devices: Optional[int] = None,
+) -> None:
+    """Join a multi-host job (idempotent). Call BEFORE creating a
+    HyperspaceSession on every process.
+
+    On TPU pods the three job parameters come from the runtime
+    environment and may be omitted (``jax.distributed.initialize()``
+    auto-detects). On CPU — the simulation used by tests and the
+    multi-host dryrun — the coordination service needs them explicitly,
+    plus the gloo cross-process collectives backend and a forced local
+    device count (``cpu_local_devices``).
+    """
+    global _DISTRIBUTED_INITIALIZED
+    if _DISTRIBUTED_INITIALIZED:
+        return
+    explicit = (coordinator_address, num_processes, process_id)
+    if any(v is not None for v in explicit) and any(
+        v is None for v in explicit
+    ):
+        raise ValueError(
+            "initialize_distributed needs coordinator_address, "
+            "num_processes AND process_id together (explicit job), or "
+            f"none of them (auto-detected TPU pod); got {explicit}"
+        )
+    if cpu_local_devices is not None:
+        jax.config.update("jax_num_cpu_devices", int(cpu_local_devices))
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs = dict(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    jax.distributed.initialize(**kwargs)
+    _DISTRIBUTED_INITIALIZED = True
 
 
 def default_mesh(devices: Optional[Sequence] = None) -> jax.sharding.Mesh:
+    """The flat data-plane mesh: ONE shard axis over every addressable
+    device. ``jax.devices()`` is process-major, so the axis is
+    ICI-contiguous per host and XLA routes the shuffle's ``all_to_all``
+    over ICI within a host and DCN across hosts."""
     devs = list(devices) if devices is not None else jax.devices()
     return jax.sharding.Mesh(np.array(devs), (SHARD_AXIS,))
+
+
+def hierarchical_mesh() -> jax.sharding.Mesh:
+    """The (dcn, ici) 2-D mesh over all hosts: outer axis = process,
+    inner axis = that process's local devices. The layout for
+    DCN-minimizing two-stage collectives (docs/MULTIHOST.md): reduce or
+    exchange over ``ici`` first (fast, within-host), then once over
+    ``dcn``."""
+    procs = jax.process_count()
+    local = jax.local_device_count()
+    devs = np.array(jax.devices()).reshape(procs, local)
+    return jax.sharding.Mesh(devs, (DCN_AXIS, ICI_AXIS))
 
 
 class MeshRuntime:
@@ -39,3 +108,14 @@ class MeshRuntime:
     @property
     def num_shards(self) -> int:
         return self.mesh.devices.size
+
+    @property
+    def process_index(self) -> int:
+        return jax.process_index()
+
+    @property
+    def is_coordinator(self) -> bool:
+        """Process 0 owns the metadata plane (action protocol, log OCC
+        writes) on a multi-host job — the driver role of the reference's
+        Spark driver (SURVEY §2.11 driver/executor row)."""
+        return jax.process_index() == 0

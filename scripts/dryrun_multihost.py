@@ -1,0 +1,136 @@
+"""Multi-host dryrun: 2 REAL processes x 4 CPU devices each.
+
+Exercises the multi-host bootstrap end-to-end (docs/MULTIHOST.md):
+
+  * ``initialize_distributed`` joins both processes into one jax job
+    (gloo CPU collectives — the simulation stand-in for DCN);
+  * the flat data-plane mesh (``default_mesh``) spans all 8 devices and
+    runs the shuffle's collective shape (shard_map all_to_all + psum)
+    ACROSS the process boundary;
+  * the hierarchical (dcn, ici) mesh runs the two-stage reduction
+    (ici-first, then dcn) and both stages agree with the flat psum.
+
+Run directly (spawns its own workers):   python scripts/dryrun_multihost.py
+Run as one worker (used by the parent):  python scripts/dryrun_multihost.py --worker <pid> <port>
+"""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def worker(pid: int, port: int) -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, REPO)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from hyperspace_tpu.parallel.mesh import (
+        DCN_AXIS,
+        ICI_AXIS,
+        SHARD_AXIS,
+        default_mesh,
+        hierarchical_mesh,
+        initialize_distributed,
+    )
+
+    initialize_distributed(
+        coordinator_address=f"localhost:{port}",
+        num_processes=2,
+        process_id=pid,
+        cpu_local_devices=4,
+    )
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+
+    # --- flat mesh: the data-plane collective shape used by the shuffle
+    mesh = default_mesh()
+    D = mesh.devices.size
+
+    def exchange(a):
+        # one all_to_all over the flat shard axis (the bucket shuffle's
+        # collective) + a psum checksum
+        b = jax.lax.all_to_all(
+            a.reshape(D, -1), SHARD_AXIS, 0, 0, tiled=False
+        )
+        return jax.lax.psum(b.sum(), SHARD_AXIS)
+
+    x = jax.device_put(
+        jnp.arange(float(D * D)).reshape(D, D),
+        NamedSharding(mesh, P(SHARD_AXIS)),
+    )
+    flat_total = jax.jit(
+        jax.shard_map(
+            exchange, mesh=mesh, in_specs=P(SHARD_AXIS), out_specs=P()
+        )
+    )(x)
+    flat_total = float(np.asarray(jax.device_get(flat_total)).ravel()[0])
+    expect = float(np.arange(D * D).sum())
+    assert flat_total == expect, (flat_total, expect)
+
+    # --- hierarchical mesh: two-stage reduction (ici first, then dcn)
+    hmesh = hierarchical_mesh()
+
+    def two_stage(a):
+        local = jax.lax.psum(a.sum(), ICI_AXIS)  # within-host (ICI)
+        return jax.lax.psum(local, DCN_AXIS)  # once across hosts (DCN)
+
+    y = jax.device_put(
+        jnp.arange(float(D * 4)).reshape(D, 4),
+        NamedSharding(hmesh, P((DCN_AXIS, ICI_AXIS))),
+    )
+    hier_total = jax.jit(
+        jax.shard_map(
+            two_stage,
+            mesh=hmesh,
+            in_specs=P((DCN_AXIS, ICI_AXIS)),
+            out_specs=P(),
+        )
+    )(y)
+    hier_total = float(np.asarray(jax.device_get(hier_total)).ravel()[0])
+    assert hier_total == float(np.arange(D * 4).sum()), hier_total
+
+    print(
+        f"DRYRUN-OK proc={pid} procs={jax.process_count()} "
+        f"devices={jax.device_count()} flat_psum={flat_total} "
+        f"two_stage={hier_total}",
+        flush=True,
+    )
+
+
+def main() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker", str(i), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    ok = 0
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        if p.returncode == 0 and "DRYRUN-OK" in out:
+            ok += 1
+        sys.stdout.write(out)
+    print(f"multihost dryrun: {ok}/2 workers ok")
+    return 0 if ok == 2 else 1
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 2 and sys.argv[1] == "--worker":
+        worker(int(sys.argv[2]), int(sys.argv[3]))
+    else:
+        raise SystemExit(main())

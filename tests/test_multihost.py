@@ -1,0 +1,33 @@
+"""Multi-host bootstrap dryrun (docs/MULTIHOST.md).
+
+Runs ``scripts/dryrun_multihost.py`` — 2 REAL processes x 4 CPU devices
+joined via ``initialize_distributed`` (gloo collectives) — asserting the
+flat shard-axis ``all_to_all``/``psum`` and the hierarchical (dcn, ici)
+two-stage reduction both execute across the process boundary. This is
+the CPU stand-in for the reference's delegated-to-Spark multi-node
+scaling (SURVEY §2.11 driver/executor row).
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_two_process_dryrun():
+    script = os.path.join(REPO, "scripts", "dryrun_multihost.py")
+    env = dict(os.environ)
+    # the workers manage their own platform/device config; drop the test
+    # session's forced XLA flags so they don't fight the workers'
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, script],
+        capture_output=True,
+        text=True,
+        timeout=280,
+        env=env,
+        cwd=REPO,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert out.stdout.count("DRYRUN-OK") == 2, out.stdout + out.stderr

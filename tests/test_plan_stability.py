@@ -15,7 +15,6 @@ and review the diff like the reference's SPARK_GENERATE_GOLDEN_FILES flow.
 """
 
 import os
-import re
 
 import numpy as np
 import pyarrow as pa
@@ -29,8 +28,9 @@ from hyperspace_tpu.indexes.covering import CoveringIndexConfig
 from hyperspace_tpu.indexes.dataskipping import DataSkippingIndexConfig
 from hyperspace_tpu.indexes.sketches import MinMaxSketch
 
+from golden_utils import check_or_generate, simplify_plan
+
 GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "goldstandard")
-GENERATE = os.environ.get("HS_GENERATE_GOLDEN_FILES") == "1"
 
 
 def _gen_tpch_mini(root):
@@ -148,8 +148,6 @@ def _queries(t):
 
 
 def simplify(plan_str: str, root: str) -> str:
-    from golden_utils import simplify_plan
-
     return simplify_plan(plan_str, root)
 
 
@@ -171,8 +169,6 @@ def test_plan_stability(qname, session, tpch):
     df = queries[qname]
     got = simplify(session.optimize(df.logical_plan).pretty(), tpch["root"])
     golden_path = os.path.join(GOLDEN_DIR, f"{qname}.txt")
-    from golden_utils import check_or_generate
-
     if check_or_generate(golden_path, got, qname):
         pytest.skip("golden file regenerated")
     # the plan must also EXECUTE and match the unindexed answer

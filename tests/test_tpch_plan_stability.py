@@ -38,7 +38,6 @@ Regenerate after an intentional planner change with:
 """
 
 import os
-import re
 
 import numpy as np
 import pyarrow as pa
