@@ -191,20 +191,12 @@ def _cacheable_scan(rel) -> bool:
     )
 
 
-def _cached_filter(
-    scan: Scan, cond: E.Expr, child_needed: Set[str], session
-) -> Optional[ColumnarBatch]:
-    """Serve a Filter∘Scan from the serve cache (None = cache off/miss
-    path not applicable; caller runs the normal read).
-
-    On a cached key-sorted index bucket a pinned-key conjunct narrows the
-    candidate rows by binary search (``ScanCacheEntry``) before the
-    full mask runs — the RAM-resident analogue of the parquet row-group
-    pruning the cold path gets from ``_pushdown_filters``, but without
-    re-reading anything.
-    """
+def _scan_cache_entry(rel, needed: Set[str], session):
+    """(ScanCacheEntry, cols) for a clean index scan from the serve
+    cache — one entry per file set, columns accruing on demand so
+    overlapping projections share a single decoded copy per column —
+    or None when serve-server mode is off / the scan is not cacheable."""
     cache = _serve_cache(session)
-    rel = scan.relation
     if cache is None or not _cacheable_scan(rel):
         return None
     from hyperspace_tpu.execution.serve_cache import (
@@ -215,11 +207,9 @@ def _cached_filter(
     fp = file_fingerprint(rel.files)
     if fp is None:
         return None
-    cols = tuple(c for c in rel.column_names if c in child_needed) or (
+    cols = tuple(c for c in rel.column_names if c in needed) or (
         rel.column_names[0],
     )
-    # one entry per file set; columns accrue on demand so overlapping
-    # projections share a single decoded copy per column
     key = ("scan", fp)
     state = cache.get(key)
     if state is None:
@@ -235,9 +225,32 @@ def _cached_filter(
         table = pio.read_table(list(rel.files), missing, rel.fmt)
         from hyperspace_tpu.io.columnar import Column
 
-        for c in missing:
-            state.add_column(c, Column.from_arrow(table.column(c)))
+        # copy-on-write publication (ScanCacheEntry concurrency
+        # contract): never mutate an entry other threads may hold
+        state = state.with_new_columns(
+            {c: Column.from_arrow(table.column(c)) for c in missing}
+        )
         cache.put(key, state, state.budget_nbytes)
+    return state, cols
+
+
+def _cached_filter(
+    scan: Scan, cond: E.Expr, child_needed: Set[str], session
+) -> Optional[ColumnarBatch]:
+    """Serve a Filter∘Scan from the serve cache (None = cache off/miss
+    path not applicable; caller runs the normal read).
+
+    On a cached key-sorted index bucket a pinned-key conjunct narrows the
+    candidate rows by binary search (``ScanCacheEntry``) before the
+    full mask runs — the RAM-resident analogue of the parquet row-group
+    pruning the cold path gets from ``_pushdown_filters``, but without
+    re-reading anything.
+    """
+    hit = _scan_cache_entry(scan.relation, child_needed, session)
+    if hit is None:
+        return None
+    state, cols = hit
+    rel = scan.relation
     batch = state.batch_for(cols)
     idx = _sorted_narrow(state, cond, rel)
     if idx is not None:

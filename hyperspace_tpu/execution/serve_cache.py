@@ -18,9 +18,9 @@ Opt-in via ``hyperspace.serve.cache.enabled`` (constants.py) — the cold
 path behaves exactly as before. What gets cached (see
 ``execution/executor.py``):
 
-* ``("scan", fp, cols)`` — the decoded ColumnarBatch of a clean index
-  scan + lazily-computed per-column sorted-segment state for the
-  binary-search point-lookup fast path;
+* ``("scan", fp)`` — per-COLUMN decoded data of a clean index scan
+  (columns accrue across projections) + lazily-computed sorted-segment
+  state for the binary-search point-lookup fast path;
 * ``("joinside", fp, cols, keys)`` — a ``PreparedJoinSide``
   (``execution/join_exec.py``): concat batch, key reps, combined keys,
   per-bucket offsets and sortedness;
@@ -123,12 +123,30 @@ class ScanCacheEntry:
     globally merged — the entry keeps per-file segment boundaries and,
     per column, whether every segment is monotonic in key-rep order,
     detected from the data (never trusted from metadata), the same
-    doctrine as the join's presorted fast path."""
+    doctrine as the join's presorted fast path.
+
+    Concurrency contract: a PUBLISHED entry (one that has been ``put``
+    into the cache) is never structurally mutated — column additions go
+    through :meth:`with_new_columns`, which builds a copy sharing the
+    existing Column objects and is published by replacing the cache
+    entry (racing writers waste a decode; readers never see a torn
+    entry). ``column_state`` memoization is the one in-place write and
+    is safe: racing threads compute identical values and dict assignment
+    is atomic."""
 
     def __init__(self, segments):
         self.segments = tuple(segments)  # ((start, end), ...)
         self.columns: dict = {}  # name -> Column
         self._reps: dict = {}  # name -> (key_rep, all_segments_sorted)
+
+    def with_new_columns(self, new_columns: dict) -> "ScanCacheEntry":
+        """A copy of this entry with ``new_columns`` added (copy-on-write
+        publication — see the concurrency contract above)."""
+        out = ScanCacheEntry(self.segments)
+        out.columns.update(self.columns)
+        out.columns.update(new_columns)
+        out._reps.update(self._reps)
+        return out
 
     @property
     def num_rows(self) -> int:

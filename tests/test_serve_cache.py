@@ -394,3 +394,135 @@ class TestPreparedJoinSide:
         )
         out = co_bucketed_join(lbs, rbs, [("k", "rk")])
         assert sorted(out.column("k").values.tolist()) == [8, 9, 9]
+
+
+class TestCachedFilteredAggregate:
+    def test_aggregate_over_cached_filter_scan(self, session, hs, tmp_path):
+        """An aggregate above an index-served FILTER runs off the cached
+        scan entry (a filterless aggregate is never index-rewritten —
+        the rules require a predicate or join, as in the reference)."""
+        from hyperspace_tpu import functions as F
+
+        src = _lineitem(tmp_path)
+        df = session.read.parquet(src)
+        hs.create_index(df, CoveringIndexConfig("agix", ["k"], ["q", "p"]))
+        session.enable_hyperspace()
+        q = lambda: (
+            df.filter(df["k"] < 200)
+            .group_by("k")
+            .agg(F.sum("q").alias("sq"), F.count().alias("n"))
+        )
+        plan = q().explain()
+        assert "Hyperspace(Type: CI" in plan
+        expected = sorted_table(q().collect())
+        session.conf.set(C.SERVE_CACHE_ENABLED, True)
+        first = sorted_table(q().collect())  # populates
+        second = sorted_table(q().collect())  # hits
+        assert first.equals(expected) and second.equals(expected)
+        assert session.serve_cache.hits > 0
+        session.conf.set(C.SERVE_CACHE_ENABLED, False)
+
+    def test_filter_queries_share_column_entries(self, session, hs, tmp_path):
+        """The per-file-set entry accrues columns: two filter queries
+        over overlapping projections decode each column once (one
+        ('scan', fp) key total)."""
+        src = _lineitem(tmp_path)
+        df = session.read.parquet(src)
+        hs.create_index(df, CoveringIndexConfig("shix", ["k"], ["q", "p"]))
+        session.conf.set(C.SERVE_CACHE_ENABLED, True)
+        session.enable_hyperspace()
+        df.filter(df["k"] > 100).select("k", "q").collect()
+        df.filter(df["k"] > 300).select("k", "p").collect()
+        assert len(session.serve_cache) == 1
+        session.conf.set(C.SERVE_CACHE_ENABLED, False)
+
+
+class TestServeCacheConcurrency:
+    def test_racing_first_touch_queries_agree(self, session, hs, tmp_path):
+        """Concurrent FIRST-TOUCH queries (cache empty when the threads
+        start) must all return the correct answer and leave the cache
+        consistent (the OCC-stress doctrine applied to the serve cache)."""
+        import threading
+
+        src = _lineitem(tmp_path)
+        df = session.read.parquet(src)
+        hs.create_index(df, CoveringIndexConfig("rcix", ["k"], ["q"]))
+        session.enable_hyperspace()
+        expected = sorted_table(  # computed BEFORE the cache exists
+            df.filter(df["k"] == 123).select("k", "q").collect()
+        )
+        session.conf.set(C.SERVE_CACHE_ENABLED, True)
+        results, errors = [], []
+
+        def worker():
+            try:
+                got = sorted_table(
+                    df.filter(df["k"] == 123).select("k", "q").collect()
+                )
+                results.append(got)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        assert len(results) == 8
+        for got in results:
+            assert got.equals(expected)
+        # later queries hit the (single) cached entry
+        session.serve_cache.hits = 0
+        sorted_table(df.filter(df["k"] == 123).select("k", "q").collect())
+        assert session.serve_cache.hits > 0
+        session.conf.set(C.SERVE_CACHE_ENABLED, False)
+
+    def test_racing_different_projections_copy_on_write(
+        self, session, hs, tmp_path
+    ):
+        """Racing queries with DIFFERENT column sets force concurrent
+        column additions to the same ('scan', fp) entry — the
+        copy-on-write publication must never expose a torn entry (the
+        in-place mutation bug showed as 'dictionary changed size during
+        iteration' in budget accounting)."""
+        import threading
+
+        src = _lineitem(tmp_path)
+        df = session.read.parquet(src)
+        hs.create_index(
+            df, CoveringIndexConfig("cwix", ["k"], ["q", "p", "s", "d"])
+        )
+        session.enable_hyperspace()
+        queries = [
+            lambda: df.filter(df["k"] == 123).select("k", "q").collect(),
+            lambda: df.filter(df["k"] == 200).select("k", "p").collect(),
+            lambda: df.filter(df["k"] == 300).select("k", "s").collect(),
+            lambda: df.filter(df["k"] == 400).select("k", "d").collect(),
+        ]
+        expected = [sorted_table(q()) for q in queries]
+        session.conf.set(C.SERVE_CACHE_ENABLED, True)
+        results = {i: [] for i in range(len(queries))}
+        errors = []
+
+        def worker(i):
+            try:
+                for _ in range(4):
+                    results[i].append(sorted_table(queries[i]()))
+            except Exception as e:
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,))
+            for i in range(len(queries))
+            for _ in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        for i, exp in enumerate(expected):
+            for got in results[i]:
+                assert got.equals(exp), i
+        session.conf.set(C.SERVE_CACHE_ENABLED, False)
