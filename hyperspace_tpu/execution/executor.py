@@ -225,11 +225,15 @@ def _scan_cache_entry(rel, needed: Set[str], session):
         table = pio.read_table(list(rel.files), missing, rel.fmt)
         from hyperspace_tpu.io.columnar import Column
 
+        new_cols = {c: Column.from_arrow(table.column(c)) for c in missing}
         # copy-on-write publication (ScanCacheEntry concurrency
-        # contract): never mutate an entry other threads may hold
-        state = state.with_new_columns(
-            {c: Column.from_arrow(table.column(c)) for c in missing}
-        )
+        # contract): never mutate an entry other threads may hold, and
+        # merge onto the FRESHEST published entry so a racing thread's
+        # just-published columns survive (loss is bounded to the
+        # re-get→put window, costing at worst a redundant decode)
+        latest = cache.get(key)
+        base = latest if latest is not None else state
+        state = base.with_new_columns(new_cols)
         cache.put(key, state, state.budget_nbytes)
     return state, cols
 

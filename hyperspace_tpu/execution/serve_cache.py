@@ -154,13 +154,11 @@ class ScanCacheEntry:
 
     def batch_for(self, cols) -> Optional[ColumnarBatch]:
         """A batch over ``cols``, or None when some column is not cached
-        yet (caller reads the missing ones and ``add_column``s them)."""
+        yet (caller reads the missing ones and publishes a copy via
+        :meth:`with_new_columns`)."""
         if any(c not in self.columns for c in cols):
             return None
         return ColumnarBatch({c: self.columns[c] for c in cols})
-
-    def add_column(self, name: str, col) -> None:
-        self.columns[name] = col
 
     def column_state(self, name: str):
         """(key_rep, all_segments_sorted) for a column, memoized."""
@@ -185,8 +183,8 @@ class ScanCacheEntry:
         """What the LRU accounting charges: every cached column PLUS its
         worst-case memoized key-rep (8 bytes/row, ``column_state``) —
         sizes are fixed at put() time, so growth must be pre-charged or
-        the byte cap stops bounding real memory. Re-put after
-        ``add_column`` to refresh the charge."""
+        the byte cap stops bounding real memory. Publishers re-put the
+        ``with_new_columns`` copy with its new charge."""
         total = 0
         rows = self.num_rows
         for c in self.columns.values():

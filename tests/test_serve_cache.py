@@ -88,9 +88,9 @@ class TestScanCacheEntry:
         batch = ColumnarBatch.from_arrow(
             pa.table({"k": pa.array(values, type=pa.int64())})
         )
-        st = ScanCacheEntry(segments)
-        st.add_column("k", batch.column("k"))
-        return st
+        return ScanCacheEntry(segments).with_new_columns(
+            {"k": batch.column("k")}
+        )
 
     def test_sorted_segments_detected(self):
         st = self._entry([1, 5, 9, 2, 3], [(0, 3), (3, 5)])
@@ -107,16 +107,19 @@ class TestScanCacheEntry:
         st = self._entry([1, 2], [(0, 2)])
         assert st.column_state("k") is st.column_state("k")
 
-    def test_columns_accrue_and_budget_grows(self):
+    def test_columns_accrue_copy_on_write(self):
         st = self._entry([1, 2], [(0, 2)])
         assert st.batch_for(["k", "v"]) is None  # v not cached yet
         b1 = st.budget_nbytes
         v = ColumnarBatch.from_arrow(
             pa.table({"v": pa.array([1.0, 2.0])})
         ).column("v")
-        st.add_column("v", v)
-        assert st.batch_for(["k", "v"]).num_rows == 2
-        assert st.budget_nbytes > b1  # re-charged for the new column
+        st2 = st.with_new_columns({"v": v})
+        assert st2.batch_for(["k", "v"]).num_rows == 2
+        assert st2.budget_nbytes > b1  # the copy is re-charged
+        assert st.batch_for(["k", "v"]) is None  # original untouched
+        # shared Column objects, not copies
+        assert st2.columns["k"] is st.columns["k"]
 
     def test_budget_charges_rep_memo(self):
         st = self._entry([1, 2], [(0, 2)])
@@ -525,4 +528,32 @@ class TestServeCacheConcurrency:
         for i, exp in enumerate(expected):
             for got in results[i]:
                 assert got.equals(exp), i
+        session.conf.set(C.SERVE_CACHE_ENABLED, False)
+
+
+class TestCachedZOrderServe:
+    def test_zorder_filter_cached_differential(self, session, hs, tmp_path):
+        """Z-order index scans cache too; their files are z-address
+        sorted (NOT single-column sorted), so the sorted-segment narrow
+        must detect unsorted columns and fall back to the full mask —
+        still answering from RAM."""
+        from hyperspace_tpu.indexes.zorder import ZOrderCoveringIndexConfig
+
+        src = _lineitem(tmp_path)
+        df = session.read.parquet(src)
+        hs.create_index(
+            df, ZOrderCoveringIndexConfig("zc", ["k", "q"], ["p"])
+        )
+        session.enable_hyperspace()
+        q = lambda: df.filter(
+            (df["k"] >= 100) & (df["k"] < 150) & (df["q"] > 10)
+        ).select("k", "q", "p")
+        plan = q().explain()
+        assert "Hyperspace(Type: ZOCI" in plan
+        expected = sorted_table(q().collect())
+        session.conf.set(C.SERVE_CACHE_ENABLED, True)
+        first = sorted_table(q().collect())
+        second = sorted_table(q().collect())
+        assert first.equals(expected) and second.equals(expected)
+        assert session.serve_cache.hits > 0
         session.conf.set(C.SERVE_CACHE_ENABLED, False)
