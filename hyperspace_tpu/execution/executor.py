@@ -228,12 +228,19 @@ def _scan_cache_entry(rel, needed: Set[str], session):
         new_cols = {c: Column.from_arrow(table.column(c)) for c in missing}
         # copy-on-write publication (ScanCacheEntry concurrency
         # contract): never mutate an entry other threads may hold, and
-        # merge onto the FRESHEST published entry so a racing thread's
-        # just-published columns survive (loss is bounded to the
-        # re-get→put window, costing at worst a redundant decode)
-        latest = cache.get(key)
+        # merge onto the FRESHEST published entry (non-counting peek) so
+        # a racing thread's just-published columns survive. The union
+        # also keeps THIS thread's stale-entry columns — the freshest
+        # entry may lack them after an evict/recreate race — so the
+        # returned entry always covers ``cols``.
+        latest = cache.peek(key)
         base = latest if latest is not None else state
-        state = base.with_new_columns(new_cols)
+        stale_extra = {
+            c: col
+            for c, col in state.columns.items()
+            if c not in base.columns
+        }
+        state = base.with_new_columns({**stale_extra, **new_cols})
         cache.put(key, state, state.budget_nbytes)
     return state, cols
 

@@ -85,6 +85,14 @@ class ServeCache:
             self.hits += 1
             return entry[0]
 
+    def peek(self, key):
+        """Read without touching hit/miss counters or LRU order — for
+        internal publication paths (re-reading the freshest entry before
+        a merge-put must not skew the query-level statistics)."""
+        with self._lock:
+            entry = self._entries.get(key)
+            return None if entry is None else entry[0]
+
     def put(self, key, value, nbytes: int) -> None:
         if nbytes > self.max_bytes:
             return  # larger than the whole cache: not cacheable

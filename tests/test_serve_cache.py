@@ -557,3 +557,68 @@ class TestCachedZOrderServe:
         assert first.equals(expected) and second.equals(expected)
         assert session.serve_cache.hits > 0
         session.conf.set(C.SERVE_CACHE_ENABLED, False)
+
+
+class TestPublicationMerge:
+    def test_peek_does_not_count(self):
+        c = ServeCache(max_bytes=100)
+        c.put("a", 1, 10)
+        assert c.peek("a") == 1 and c.peek("b") is None
+        assert (c.hits, c.misses) == (0, 0)
+
+    def test_evict_recreate_race_keeps_needed_columns(self, session, hs, tmp_path):
+        """If the entry is evicted and re-created with a DIFFERENT
+        projection between a thread's get and its publication, the
+        published union must still cover the thread's columns (the
+        stale-extra merge) — previously batch_for returned None and the
+        query crashed."""
+        import pyarrow as pa
+
+        from hyperspace_tpu.execution.serve_cache import ScanCacheEntry
+        from hyperspace_tpu.execution import executor as X
+
+        src = _lineitem(tmp_path)
+        df = session.read.parquet(src)
+        hs.create_index(df, CoveringIndexConfig("evix", ["k"], ["q", "p"]))
+        session.conf.set(C.SERVE_CACHE_ENABLED, True)
+        session.enable_hyperspace()
+        # populate {k, q}
+        expected = sorted_table(
+            df.filter(df["k"] == 123).select("k", "q").collect()
+        )
+        cache = session.serve_cache
+        (key,) = [k for k in cache._entries if k[0] == "scan"]
+        # simulate the race: replace the entry with a DIFFERENT projection
+        # ({p} only) between this query's get and publication, by patching
+        # peek to swap the entry the first time it's consulted
+        real_peek = cache.peek
+        swapped = {"done": False}
+
+        def racing_peek(k):
+            if not swapped["done"] and k == key:
+                swapped["done"] = True
+                entry = real_peek(k)
+                other = ScanCacheEntry(entry.segments).with_new_columns(
+                    {"p": entry.columns.get("p")}
+                    if "p" in entry.columns
+                    else {}
+                )
+                cache.put(k, other, 1)
+                return other
+            return real_peek(k)
+
+        cache.peek = racing_peek
+        try:
+            # query needing {k, d}: 'd' is missing -> publication path runs
+            got = sorted_table(
+                df.filter(df["k"] == 123).select("k", "d").collect()
+            )
+            assert got.num_rows == expected.num_rows
+            # and the original projection still answers correctly
+            again = sorted_table(
+                df.filter(df["k"] == 123).select("k", "q").collect()
+            )
+            assert again.equals(expected)
+        finally:
+            cache.peek = real_peek
+        session.conf.set(C.SERVE_CACHE_ENABLED, False)
