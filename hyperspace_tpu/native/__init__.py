@@ -1,0 +1,135 @@
+"""Optional native (C++) host kernels.
+
+The TPU compute path is JAX/XLA; this package accelerates the HOST side
+of the pipeline, where the dispatch policy (see ``ops/sort.py``) keeps
+host-resident batches because transfer to a tunnel-attached chip dwarfs
+the compute. The one hot host op is the stable multi-plane lexsort behind
+the bucketed sorted write (reference:
+``index/DataFrameWriterExtensions.scala:58-67``).
+
+The kernel is compiled from ``hs_native.cpp`` on first use with ``g++``
+and cached next to the source, keyed by a hash of the source so edits
+rebuild automatically. Everything degrades gracefully: no compiler, a
+failed build, or ``HS_NATIVE=0`` all fall back to the numpy twins with
+identical (stable) semantics — callers treat ``None`` from the wrappers
+as "use numpy".
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "hs_native.cpp")
+_lock = threading.Lock()
+_lib = None
+_load_failed = False
+
+
+def _cache_path() -> str:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    return os.path.join(os.path.dirname(__file__), f"_hs_native_{digest}.so")
+
+
+def _compile(path: str) -> bool:
+    """Build the shared library; atomic publish via rename so concurrent
+    processes never load a half-written file."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    # No -march=native: the kernel is scalar counting-sort (memory-bound,
+    # nothing to vectorize), and a cached .so may outlive the machine it
+    # was built on (baked image, shared filesystem) — ISA-specific code
+    # would then SIGILL with no chance for the numpy fallback to engage.
+    cmd = [
+        "g++",
+        "-O3",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        "-pthread",
+        _SRC,
+        "-o",
+        tmp,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+        os.replace(tmp, path)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def load():
+    """The loaded CDLL, or None when native kernels are unavailable."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if os.environ.get("HS_NATIVE", "1") == "0":
+            _load_failed = True
+            return None
+        path = _cache_path()
+        if not os.path.exists(path) and not _compile(path):
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+            lib.hs_lexsort_u32.restype = ctypes.c_int
+            lib.hs_lexsort_u32.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.c_int32,
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int32,
+            ]
+        except (OSError, AttributeError):
+            _load_failed = True
+            return None
+        _lib = lib
+        return _lib
+
+
+def _n_threads() -> int:
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        cores = os.cpu_count() or 1
+    return max(1, min(cores, 16))
+
+
+def lexsort_u32(planes: np.ndarray) -> Optional[np.ndarray]:
+    """Stable ascending lexsort permutation by uint32 ``planes`` [k, n]
+    (plane 0 major) — bit-identical to ``np.lexsort(planes[::-1])``.
+    Returns None when the native kernel is unavailable, so callers fall
+    back to numpy."""
+    lib = load()
+    if lib is None:
+        return None
+    planes = np.ascontiguousarray(planes, dtype=np.uint32)
+    k, n = planes.shape
+    out = np.empty(n, dtype=np.int64)
+    ptrs = (ctypes.c_void_p * k)(
+        *(planes[i].ctypes.data for i in range(k))
+    )
+    rc = lib.hs_lexsort_u32(
+        ptrs,
+        ctypes.c_int32(k),
+        ctypes.c_int64(n),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.c_int32(_n_threads()),
+    )
+    if rc != 0:
+        return None
+    return out

@@ -1,0 +1,159 @@
+// Native host kernels for hyperspace_tpu.
+//
+// The TPU compute path is JAX/XLA; these kernels cover the HOST side of
+// the build/serve pipeline (the dispatch policy in ops/sort.py keeps
+// host-resident batches off the device because PCIe/tunnel transfer
+// dwarfs the compute). The hot host op is the stable multi-plane lexsort
+// behind the bucketed sorted write (reference: the sort-within-bucket of
+// index/DataFrameWriterExtensions.scala:58-67); numpy's lexsort runs one
+// full stable argsort per plane with an index gather each time, while
+// this kernel runs one adaptive LSD radix sort over all planes and skips
+// byte passes whose digits are constant across rows — on real index
+// workloads most passes are (bucket ids span a few bits, the hi word of
+// a small int64 key is the constant sign bit).
+//
+// Contract: identical output to np.lexsort(planes[::-1]) — stable,
+// ascending, plane 0 major. Ties keep input order; counting sort is
+// stable by construction and planes are processed least-significant
+// first, so the composition is stable overall.
+//
+// Threading: pass n_threads > 1 to split histogram+scatter by contiguous
+// input chunks (per-chunk digit offsets keep stability). The caller
+// picks n_threads from the machine; 1 means plain loops with no thread
+// machinery at all.
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Buffers {
+  std::vector<int64_t> perm_a, perm_b;
+  std::vector<uint32_t> key_a, key_b;
+};
+
+// One stable counting-sort pass by byte `shift` of key_a, moving
+// (key, perm) pairs into (key_b, perm_b). Single-threaded.
+void pass_serial(Buffers& buf, int64_t n, int shift) {
+  int64_t count[256] = {0};
+  const uint32_t* ka = buf.key_a.data();
+  for (int64_t i = 0; i < n; ++i) ++count[(ka[i] >> shift) & 0xFF];
+  int64_t offset[256];
+  int64_t running = 0;
+  for (int d = 0; d < 256; ++d) {
+    offset[d] = running;
+    running += count[d];
+  }
+  const int64_t* pa = buf.perm_a.data();
+  uint32_t* kb = buf.key_b.data();
+  int64_t* pb = buf.perm_b.data();
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t pos = offset[(ka[i] >> shift) & 0xFF]++;
+    kb[pos] = ka[i];
+    pb[pos] = pa[i];
+  }
+}
+
+// Threaded variant: per-chunk histograms, then global offsets laid out
+// digit-major chunk-minor so each chunk scatters into disjoint, stably
+// ordered slots.
+void pass_threaded(Buffers& buf, int64_t n, int shift, int n_threads) {
+  const int T = n_threads;
+  std::vector<int64_t> counts(static_cast<size_t>(T) * 256, 0);
+  const uint32_t* ka = buf.key_a.data();
+  const int64_t chunk = (n + T - 1) / T;
+  auto hist = [&](int t) {
+    int64_t lo = t * chunk, hi = std::min<int64_t>(n, lo + chunk);
+    int64_t* c = counts.data() + static_cast<size_t>(t) * 256;
+    for (int64_t i = lo; i < hi; ++i) ++c[(ka[i] >> shift) & 0xFF];
+  };
+  {
+    std::vector<std::thread> ts;
+    for (int t = 1; t < T; ++t) ts.emplace_back(hist, t);
+    hist(0);
+    for (auto& th : ts) th.join();
+  }
+  // offsets[t][d]: digit-major, chunk-minor prefix sum
+  std::vector<int64_t> offsets(static_cast<size_t>(T) * 256);
+  int64_t running = 0;
+  for (int d = 0; d < 256; ++d) {
+    for (int t = 0; t < T; ++t) {
+      offsets[static_cast<size_t>(t) * 256 + d] = running;
+      running += counts[static_cast<size_t>(t) * 256 + d];
+    }
+  }
+  const int64_t* pa = buf.perm_a.data();
+  uint32_t* kb = buf.key_b.data();
+  int64_t* pb = buf.perm_b.data();
+  auto scatter = [&](int t) {
+    int64_t lo = t * chunk, hi = std::min<int64_t>(n, lo + chunk);
+    int64_t* off = offsets.data() + static_cast<size_t>(t) * 256;
+    for (int64_t i = lo; i < hi; ++i) {
+      int64_t pos = off[(ka[i] >> shift) & 0xFF]++;
+      kb[pos] = ka[i];
+      pb[pos] = pa[i];
+    }
+  };
+  {
+    std::vector<std::thread> ts;
+    for (int t = 1; t < T; ++t) ts.emplace_back(scatter, t);
+    scatter(0);
+    for (auto& th : ts) th.join();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Stable ascending lexsort of n rows by k uint32 planes; planes[0] is
+// the MAJOR key. Writes the permutation into out (int64, length n).
+// Returns 0 on success, nonzero on bad arguments.
+int hs_lexsort_u32(const uint32_t** planes, int32_t k, int64_t n,
+                   int64_t* out, int32_t n_threads) {
+  if (n < 0 || k < 0 || (n > 0 && out == nullptr)) return 1;
+  for (int64_t i = 0; i < n; ++i) out[i] = i;
+  if (n <= 1 || k == 0) return 0;
+  if (n_threads < 1) n_threads = 1;
+
+  Buffers buf;
+  buf.perm_a.resize(n);
+  buf.perm_b.resize(n);
+  buf.key_a.resize(n);
+  buf.key_b.resize(n);
+  std::memcpy(buf.perm_a.data(), out, static_cast<size_t>(n) * 8);
+
+  for (int p = k - 1; p >= 0; --p) {
+    const uint32_t* plane = planes[p];
+    // Byte-activity mask: a byte position where every row agrees cannot
+    // change the order — skip its pass. Order-independent, so it runs on
+    // the raw plane BEFORE paying the random gather; a constant plane
+    // (e.g. the hi word of small int64 keys) costs one sequential scan.
+    uint32_t mask = 0;
+    const uint32_t v0 = plane[0];
+    for (int64_t i = 1; i < n; ++i) mask |= plane[i] ^ v0;
+    if (mask == 0) continue;
+    // Gather the plane into the current permutation order (sequential
+    // writes; the random reads are the unavoidable cost of composing
+    // with the earlier planes' order).
+    const int64_t* pa = buf.perm_a.data();
+    uint32_t* ka = buf.key_a.data();
+    for (int64_t i = 0; i < n; ++i) ka[i] = plane[pa[i]];
+    for (int shift = 0; shift < 32; shift += 8) {
+      if (((mask >> shift) & 0xFF) == 0) continue;
+      if (n_threads > 1) {
+        pass_threaded(buf, n, shift, n_threads);
+      } else {
+        pass_serial(buf, n, shift);
+      }
+      buf.perm_a.swap(buf.perm_b);
+      buf.key_a.swap(buf.key_b);
+    }
+  }
+  std::memcpy(out, buf.perm_a.data(), static_cast<size_t>(n) * 8);
+  return 0;
+}
+
+}  // extern "C"

@@ -33,6 +33,14 @@ _SIGN = np.uint32(0x80000000)
 # the host path covers every practical single-host size.
 _HOST_SORT_MAX_ROWS = 1 << 26
 
+# At or above this row count the host path prefers the native C++ radix
+# lexsort (hyperspace_tpu/native): one adaptive LSD radix over all planes
+# with constant-byte pass skipping, measured 3.3x over np.lexsort at the
+# 4M-row bench shape (bit-identical stable output). Below it numpy's
+# overhead is already microseconds and a first native call would pay the
+# one-time g++ compile for nothing.
+_NATIVE_SORT_MIN_ROWS = 1 << 15
+
 
 def _order_words_np(key_reps: np.ndarray) -> np.ndarray:
     """[k, n] int64 -> [2k, n] uint32 planes whose lexicographic order
@@ -69,8 +77,14 @@ def lexsort_perm(planes: np.ndarray, n_valid: int | None = None) -> np.ndarray:
         return np.zeros(0, dtype=np.int64)
     planes = planes.astype(np.uint32, copy=False)
     if planes.shape[1] <= _HOST_SORT_MAX_ROWS:
-        # host numpy lexsort: same stable semantics, no device round trip
+        # host lexsort: same stable semantics, no device round trip
         # (host-resident serve batches pay transfer + readback otherwise)
+        if planes.shape[1] >= _NATIVE_SORT_MIN_ROWS:
+            from hyperspace_tpu import native
+
+            perm = native.lexsort_u32(planes)
+            if perm is not None:
+                return perm[:n]
         return np.lexsort(planes[::-1])[:n]
     n_pad = pad_len(planes.shape[1])
     if n_pad != planes.shape[1]:
