@@ -31,6 +31,12 @@ from hyperspace_tpu.io.columnar import ColumnarBatch
 
 _SENTINEL_BASE = np.int64(-0x4000000000000000)
 
+# At or above this combined per-bucket row count the host match uses the
+# native linear merge-join (hyperspace_tpu/native). Below it numpy's
+# searchsorted overhead is already microseconds and a first native call
+# would pay the one-time g++ compile for nothing.
+_NATIVE_JOIN_MIN_ROWS = 1 << 14
+
 
 def merge_join_indices(
     l_reps: np.ndarray, r_reps: np.ndarray
@@ -270,16 +276,29 @@ def _host_match(
         if not r_sorted:
             perm_r = np.argsort(rs, kind="stable")
             rs = rs[perm_r]
-        lo = np.searchsorted(rs, ls, side="left")
-        hi = np.searchsorted(rs, ls, side="right")
-        cnt = hi - lo
-        total = int(cnt.sum())
-        if total == 0:
-            continue
-        li_sorted = np.repeat(np.arange(lsz, dtype=np.int64), cnt)
-        starts = np.concatenate([[0], np.cumsum(cnt)[:-1]])
-        within = np.arange(total, dtype=np.int64) - np.repeat(starts, cnt)
-        ri_sorted = np.repeat(lo, cnt) + within
+        pair = None
+        if lsz + rsz >= _NATIVE_JOIN_MIN_ROWS:
+            from hyperspace_tpu import native
+
+            # both slices are sorted here, so the native linear merge
+            # (O(n+m+pairs) sequential) replaces n binary searches into m
+            # plus numpy's multi-pass pair expansion; identical output
+            pair = native.merge_join_i64(ls, rs)
+        if pair is not None:
+            li_sorted, ri_sorted = pair
+            if len(li_sorted) == 0:
+                continue
+        else:
+            lo = np.searchsorted(rs, ls, side="left")
+            hi = np.searchsorted(rs, ls, side="right")
+            cnt = hi - lo
+            total = int(cnt.sum())
+            if total == 0:
+                continue
+            li_sorted = np.repeat(np.arange(lsz, dtype=np.int64), cnt)
+            starts = np.concatenate([[0], np.cumsum(cnt)[:-1]])
+            within = np.arange(total, dtype=np.int64) - np.repeat(starts, cnt)
+            ri_sorted = np.repeat(lo, cnt) + within
         li = perm_l[li_sorted] if perm_l is not None else li_sorted
         ri = perm_r[ri_sorted] if perm_r is not None else ri_sorted
         li_parts.append(li + loff)

@@ -18,13 +18,17 @@ as "use numpy".
 from __future__ import annotations
 
 import ctypes
+import glob
 import hashlib
+import logging
 import os
 import subprocess
 import threading
 from typing import Optional
 
 import numpy as np
+
+_log = logging.getLogger("hyperspace_tpu.native")
 
 _SRC = os.path.join(os.path.dirname(__file__), "hs_native.cpp")
 _lock = threading.Lock()
@@ -38,9 +42,25 @@ def _cache_path() -> str:
     return os.path.join(os.path.dirname(__file__), f"_hs_native_{digest}.so")
 
 
+def _cleanup_superseded(keep: str) -> None:
+    """Drop artifacts of older source revisions (the cache is keyed by a
+    source hash, so every edit would otherwise strand one .so forever —
+    a real leak on shared filesystems and baked images)."""
+    pattern = os.path.join(os.path.dirname(__file__), "_hs_native_*")
+    for old in glob.glob(pattern):
+        if not old.startswith(keep):
+            try:
+                os.unlink(old)
+            except OSError:
+                pass
+
+
 def _compile(path: str) -> bool:
     """Build the shared library; atomic publish via rename so concurrent
-    processes never load a half-written file."""
+    processes never load a half-written file. A failure writes a
+    ``.failed`` marker with the compiler's stderr next to the source —
+    later processes skip the doomed ~2s retry and operators get a
+    diagnostic instead of a silent numpy fallback."""
     tmp = f"{path}.tmp.{os.getpid()}"
     # No -march=native: the kernel is scalar counting-sort (memory-bound,
     # nothing to vectorize), and a cached .so may outlive the machine it
@@ -60,30 +80,60 @@ def _compile(path: str) -> bool:
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=300)
         os.replace(tmp, path)
+        _cleanup_superseded(path)
         return True
-    except (OSError, subprocess.SubprocessError):
+    except (OSError, subprocess.SubprocessError) as exc:
         try:
             os.unlink(tmp)
+        except OSError:
+            pass
+        stderr = getattr(exc, "stderr", b"") or b""
+        detail = stderr.decode("utf-8", "replace")[-2000:] or str(exc)
+        _log.warning(
+            "native kernel build failed; falling back to numpy twins "
+            "(delete %s.failed to retry): %s",
+            path,
+            detail,
+        )
+        try:
+            with open(path + ".failed", "w") as f:
+                f.write(detail)
         except OSError:
             pass
         return False
 
 
-def load():
-    """The loaded CDLL, or None when native kernels are unavailable."""
+def load(wait: bool = True):
+    """The loaded CDLL, or None when native kernels are unavailable.
+
+    ``wait=False`` returns None instead of blocking when another thread
+    is mid-compile — hot paths fall back to numpy for the couple of
+    seconds a background pre-warm (``HyperspaceSession`` startup) needs,
+    rather than stalling a query on the one-time g++ run."""
     global _lib, _load_failed
     if _lib is not None or _load_failed:
         return _lib
-    with _lock:
+    if not _lock.acquire(blocking=wait):
+        return None
+    try:
         if _lib is not None or _load_failed:
             return _lib
         if os.environ.get("HS_NATIVE", "1") == "0":
             _load_failed = True
             return None
         path = _cache_path()
-        if not os.path.exists(path) and not _compile(path):
-            _load_failed = True
-            return None
+        if not os.path.exists(path):
+            if os.path.exists(path + ".failed"):
+                _log.warning(
+                    "native kernel disabled: previous build failed "
+                    "(see %s.failed; delete it to retry)",
+                    path,
+                )
+                _load_failed = True
+                return None
+            if not _compile(path):
+                _load_failed = True
+                return None
         try:
             lib = ctypes.CDLL(path)
             lib.hs_lexsort_u32.restype = ctypes.c_int
@@ -94,11 +144,30 @@ def load():
                 ctypes.POINTER(ctypes.c_int64),
                 ctypes.c_int32,
             ]
+            _i64p = ctypes.POINTER(ctypes.c_int64)
+            lib.hs_merge_join_count_i64.restype = ctypes.c_int64
+            lib.hs_merge_join_count_i64.argtypes = [
+                _i64p,
+                ctypes.c_int64,
+                _i64p,
+                ctypes.c_int64,
+            ]
+            lib.hs_merge_join_emit_i64.restype = ctypes.c_int64
+            lib.hs_merge_join_emit_i64.argtypes = [
+                _i64p,
+                ctypes.c_int64,
+                _i64p,
+                ctypes.c_int64,
+                _i64p,
+                _i64p,
+            ]
         except (OSError, AttributeError):
             _load_failed = True
             return None
         _lib = lib
         return _lib
+    finally:
+        _lock.release()
 
 
 def _n_threads() -> int:
@@ -114,7 +183,7 @@ def lexsort_u32(planes: np.ndarray) -> Optional[np.ndarray]:
     (plane 0 major) — bit-identical to ``np.lexsort(planes[::-1])``.
     Returns None when the native kernel is unavailable, so callers fall
     back to numpy."""
-    lib = load()
+    lib = load(wait=False)
     if lib is None:
         return None
     planes = np.ascontiguousarray(planes, dtype=np.uint32)
@@ -133,3 +202,32 @@ def lexsort_u32(planes: np.ndarray) -> Optional[np.ndarray]:
     if rc != 0:
         return None
     return out
+
+
+def merge_join_i64(
+    l_sorted: np.ndarray, r_sorted: np.ndarray
+) -> Optional[tuple]:
+    """Inner-join pair indices (li, ri) of two ASCENDING-sorted int64 key
+    arrays (duplicates allowed): one linear merge per pass, pairs ordered
+    by left index then right index — identical to the numpy
+    searchsorted + repeat expansion it replaces. Returns None when the
+    native kernel is unavailable."""
+    lib = load(wait=False)
+    if lib is None:
+        return None
+    l_sorted = np.ascontiguousarray(l_sorted, dtype=np.int64)
+    r_sorted = np.ascontiguousarray(r_sorted, dtype=np.int64)
+    _i64p = ctypes.POINTER(ctypes.c_int64)
+    lp = l_sorted.ctypes.data_as(_i64p)
+    rp = r_sorted.ctypes.data_as(_i64p)
+    n, m = len(l_sorted), len(r_sorted)
+    total = lib.hs_merge_join_count_i64(lp, n, rp, m)
+    li = np.empty(total, dtype=np.int64)
+    ri = np.empty(total, dtype=np.int64)
+    if total:
+        emitted = lib.hs_merge_join_emit_i64(
+            lp, n, rp, m, li.ctypes.data_as(_i64p), ri.ctypes.data_as(_i64p)
+        )
+        if emitted != total:  # pragma: no cover — would be a kernel bug
+            return None
+    return li, ri

@@ -156,4 +156,63 @@ int hs_lexsort_u32(const uint32_t** planes, int32_t k, int64_t n,
   return 0;
 }
 
+// Inner-join pair count of two ASCENDING-sorted int64 key arrays
+// (duplicates allowed on both sides): one linear merge, no allocation.
+// This is the serve-side payoff of the co-bucketed covering index — both
+// bucket slices come off disk key-sorted (reference: the no-shuffle SMJ
+// of covering/JoinIndexRule.scala:619-634), so matching is O(n+m+pairs)
+// sequential instead of n binary searches into m.
+int64_t hs_merge_join_count_i64(const int64_t* l, int64_t n,
+                                const int64_t* r, int64_t m) {
+  int64_t total = 0;
+  int64_t i = 0, j = 0;
+  while (i < n && j < m) {
+    if (l[i] < r[j]) {
+      ++i;
+    } else if (l[i] > r[j]) {
+      ++j;
+    } else {
+      const int64_t v = l[i];
+      int64_t i2 = i, j2 = j;
+      while (i2 < n && l[i2] == v) ++i2;
+      while (j2 < m && r[j2] == v) ++j2;
+      total += (i2 - i) * (j2 - j);
+      i = i2;
+      j = j2;
+    }
+  }
+  return total;
+}
+
+// Emit the matching pairs of two ASCENDING-sorted int64 key arrays into
+// li/ri (capacity = hs_merge_join_count_i64's result). Order: left index
+// ascending, right index ascending within each left row — identical to
+// the numpy searchsorted+repeat expansion it replaces.
+int64_t hs_merge_join_emit_i64(const int64_t* l, int64_t n,
+                               const int64_t* r, int64_t m, int64_t* li,
+                               int64_t* ri) {
+  int64_t out = 0;
+  int64_t i = 0, j = 0;
+  while (i < n && j < m) {
+    if (l[i] < r[j]) {
+      ++i;
+    } else if (l[i] > r[j]) {
+      ++j;
+    } else {
+      const int64_t v = l[i];
+      int64_t j2 = j;
+      while (j2 < m && r[j2] == v) ++j2;
+      for (; i < n && l[i] == v; ++i) {
+        for (int64_t jj = j; jj < j2; ++jj) {
+          li[out] = i;
+          ri[out] = jj;
+          ++out;
+        }
+      }
+      j = j2;
+    }
+  }
+  return out;
+}
+
 }  // extern "C"

@@ -134,6 +134,13 @@ class HyperspaceSession:
         self._serve_cache = None
         self._serve_cache_lock = threading.Lock()
         self._catalog: dict = {}
+        # Pre-warm the native host kernels off-thread: the one-time g++
+        # compile (~2s, cached per machine) then lands during session
+        # setup instead of inside the first large sort or join; hot paths
+        # use load(wait=False) and fall back to numpy until it finishes.
+        from hyperspace_tpu import native
+
+        threading.Thread(target=native.load, daemon=True).start()
 
     # -- context (HyperspaceContext, Hyperspace.scala:195-223) --------------
     @property

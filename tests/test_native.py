@@ -97,6 +97,59 @@ class TestLexsortParity:
         _check(planes)
 
 
+def _merge_ref(ls, rs):
+    """The numpy searchsorted+repeat expansion the kernel replaces."""
+    if len(ls) == 0 or len(rs) == 0:
+        z = np.zeros(0, dtype=np.int64)
+        return z, z
+    lo = np.searchsorted(rs, ls, side="left")
+    hi = np.searchsorted(rs, ls, side="right")
+    cnt = hi - lo
+    total = int(cnt.sum())
+    li = np.repeat(np.arange(len(ls), dtype=np.int64), cnt)
+    starts = np.concatenate([[0], np.cumsum(cnt)[:-1]])
+    within = np.arange(total, dtype=np.int64) - np.repeat(starts, cnt)
+    return li, np.repeat(lo, cnt) + within
+
+
+class TestMergeJoinParity:
+    def _check(self, ls, rs):
+        ls = np.sort(np.asarray(ls, dtype=np.int64))
+        rs = np.sort(np.asarray(rs, dtype=np.int64))
+        got = native.merge_join_i64(ls, rs)
+        assert got is not None
+        ref = _merge_ref(ls, rs)
+        np.testing.assert_array_equal(got[0], ref[0])
+        np.testing.assert_array_equal(got[1], ref[1])
+
+    def test_empty_sides(self):
+        self._check([], [])
+        self._check([1, 2], [])
+        self._check([], [1, 2])
+
+    def test_no_overlap(self):
+        self._check([1, 2, 3], [4, 5, 6])
+        self._check([4, 5, 6], [1, 2, 3])
+
+    def test_duplicates_both_sides(self):
+        self._check([1, 1, 2, 2, 2, 3], [2, 2, 3, 3])
+
+    def test_all_equal(self):
+        self._check(np.zeros(100), np.zeros(50))
+
+    def test_negative_and_extremes(self):
+        vals = [-(2**62), -1, 0, 1, 2**62]
+        rng = np.random.default_rng(3)
+        self._check(rng.choice(vals, 1000), rng.choice(vals, 700))
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_random(self, seed):
+        rng = np.random.default_rng(seed)
+        self._check(
+            rng.integers(0, 10_000, 50_000), rng.integers(0, 10_000, 8_000)
+        )
+
+
 class TestDispatch:
     def test_lexsort_perm_uses_native_above_threshold(self, monkeypatch):
         """lexsort_perm output is unchanged whichever engine runs."""
