@@ -328,10 +328,18 @@ def _dictionary_columns(table: pa.Table):
     But LOW-cardinality numerics (dates, flags, quantities) genuinely
     shrink under RLE_DICTIONARY (~2x on such columns), so the opt-out is
     gated on sampled cardinality: a column keeps dictionary encoding when
-    a prefix sample repeats values at least 4x. Strings/binary always
+    a STRIDED sample repeats values at least 4x. The stride matters —
+    index tables arrive key-sorted, so a prefix sample would see only the
+    clustered duplicates of the first few keys and re-enable dictionary
+    encoding for globally high-cardinality columns. Strings/binary always
     keep it."""
     cols = []
     n = table.num_rows
+    sample_idx = None
+    if n > _DICT_SAMPLE_ROWS:
+        sample_idx = pa.array(
+            np.linspace(0, n - 1, _DICT_SAMPLE_ROWS).astype(np.int64)
+        )
     for i, f in enumerate(table.schema):
         if (
             pa.types.is_string(f.type)
@@ -343,7 +351,8 @@ def _dictionary_columns(table: pa.Table):
             continue
         if n == 0:
             continue
-        sample = table.column(i).slice(0, min(n, _DICT_SAMPLE_ROWS))
+        col = table.column(i)
+        sample = col.take(sample_idx) if sample_idx is not None else col
         try:
             distinct = len(sample.unique())
         except pa.ArrowNotImplementedError:

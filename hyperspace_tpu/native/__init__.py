@@ -48,6 +48,12 @@ def _cleanup_superseded(keep: str) -> None:
     a real leak on shared filesystems and baked images)."""
     pattern = os.path.join(os.path.dirname(__file__), "_hs_native_*")
     for old in glob.glob(pattern):
+        # Never touch .tmp.<pid> files: on a shared filesystem another
+        # process may be mid-compile of a DIFFERENT source revision, and
+        # unlinking its tmp would fail its os.replace and latch a bogus
+        # .failed marker. Orphaned tmps (SIGKILL) are gitignored noise.
+        if ".tmp." in os.path.basename(old):
+            continue
         if not old.startswith(keep):
             try:
                 os.unlink(old)
@@ -161,6 +167,15 @@ def load(wait: bool = True):
                 _i64p,
                 _i64p,
             ]
+            lib.hs_bucket_ids_i64.restype = ctypes.c_int
+            lib.hs_bucket_ids_i64.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.c_int32,
+                ctypes.c_int64,
+                ctypes.c_uint32,
+                ctypes.c_uint32,
+                ctypes.POINTER(ctypes.c_int32),
+            ]
         except (OSError, AttributeError):
             _load_failed = True
             return None
@@ -231,3 +246,31 @@ def merge_join_i64(
         if emitted != total:  # pragma: no cover — would be a kernel bug
             return None
     return li, ri
+
+
+def bucket_ids_i64(
+    key_reps: np.ndarray, num_buckets: int, seed: int = 42
+) -> Optional[np.ndarray]:
+    """Murmur3-32 bucket ids over [k, n] int64 key reps in one pass per
+    row — bit-exact twin of ``ops/hash.bucket_ids_host``. Returns None
+    when the native kernel is unavailable."""
+    lib = load(wait=False)
+    if lib is None:
+        return None
+    key_reps = np.ascontiguousarray(key_reps, dtype=np.int64)
+    k, n = key_reps.shape
+    out = np.empty(n, dtype=np.int32)
+    ptrs = (ctypes.c_void_p * k)(
+        *(key_reps[i].ctypes.data for i in range(k))
+    )
+    rc = lib.hs_bucket_ids_i64(
+        ptrs,
+        ctypes.c_int32(k),
+        ctypes.c_int64(n),
+        ctypes.c_uint32(seed),
+        ctypes.c_uint32(num_buckets),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if rc != 0:
+        return None
+    return out

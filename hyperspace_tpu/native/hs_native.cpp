@@ -215,4 +215,44 @@ int64_t hs_merge_join_emit_i64(const int64_t* l, int64_t n,
   return out;
 }
 
+// MurmurHash3-32 bucket ids over k int64 key columns, one pass per row.
+// Bit-exact twin of ops/hash.bucket_ids_host (numpy) and the XLA kernel:
+// each key rep contributes its lo then hi uint32 word to the block
+// stream, fmix length is 8*k bytes, bucket = h % num_buckets. The numpy
+// twin makes ~10 full-array passes over the mix pipeline; this is one.
+static inline uint32_t rotl32(uint32_t x, int r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+static inline uint32_t mm3_mix(uint32_t h, uint32_t w) {
+  uint32_t k1 = w * 0xCC9E2D51u;
+  k1 = rotl32(k1, 15);
+  k1 *= 0x1B873593u;
+  h ^= k1;
+  h = rotl32(h, 13);
+  return h * 5u + 0xE6546B64u;
+}
+
+int hs_bucket_ids_i64(const int64_t** keys, int32_t k, int64_t n,
+                      uint32_t seed, uint32_t num_buckets, int32_t* out) {
+  if (n < 0 || k <= 0 || num_buckets == 0) return 1;
+  const uint32_t len = 8u * static_cast<uint32_t>(k);
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t h = seed;
+    for (int32_t j = 0; j < k; ++j) {
+      const uint64_t v = static_cast<uint64_t>(keys[j][i]);
+      h = mm3_mix(h, static_cast<uint32_t>(v));
+      h = mm3_mix(h, static_cast<uint32_t>(v >> 32));
+    }
+    h ^= len;
+    h ^= h >> 16;
+    h *= 0x85EBCA6Bu;
+    h ^= h >> 13;
+    h *= 0xC2B2AE35u;
+    h ^= h >> 16;
+    out[i] = static_cast<int32_t>(h % num_buckets);
+  }
+  return 0;
+}
+
 }  // extern "C"

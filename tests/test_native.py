@@ -150,6 +150,53 @@ class TestMergeJoinParity:
         )
 
 
+class TestBucketIdsParity:
+    def _check(self, reps, num_buckets, seed=42):
+        import hyperspace_tpu.ops.hash as hash_mod
+
+        reps = np.asarray(reps, dtype=np.int64)
+        got = native.bucket_ids_i64(reps, num_buckets, seed)
+        assert got is not None
+        # numpy twin, forced (bypass the native dispatch inside)
+        words = hash_mod.split_words_np(reps)
+        with np.errstate(over="ignore"):
+            h = np.full(reps.shape[1], np.uint32(seed))
+            for i in range(words.shape[0]):
+                h = hash_mod._mix_h1(h, hash_mod._mix_k1(words[i]))
+            h = hash_mod._fmix(h, np.uint32(4 * words.shape[0]))
+        ref = (h % np.uint32(num_buckets)).astype(np.int32)
+        np.testing.assert_array_equal(got, ref)
+
+    @pytest.mark.parametrize("k", [1, 2, 3])
+    def test_random(self, k):
+        rng = np.random.default_rng(k)
+        self._check(
+            rng.integers(-(2**62), 2**62, size=(k, 40_000)), 8
+        )
+
+    def test_extremes_and_buckets(self):
+        vals = np.array(
+            [[-(2**63), 2**63 - 1, 0, -1, 1, 42]], dtype=np.int64
+        )
+        for nb in (1, 2, 7, 200, 65536):
+            self._check(vals, nb)
+
+    def test_dispatch_parity_end_to_end(self):
+        """bucket_ids_host output is identical above/below the native
+        threshold for the same values."""
+        import hyperspace_tpu.ops.hash as hash_mod
+
+        rng = np.random.default_rng(9)
+        n = hash_mod._NATIVE_HASH_MIN_ROWS + 7
+        reps = rng.integers(-(2**40), 2**40, size=(2, n))
+        big = hash_mod.bucket_ids_host(reps, 16)
+        small_parts = [
+            hash_mod.bucket_ids_host(reps[:, i : i + 1000], 16)
+            for i in range(0, n, 1000)
+        ]
+        np.testing.assert_array_equal(big, np.concatenate(small_parts))
+
+
 class TestDispatch:
     def test_lexsort_perm_uses_native_above_threshold(self, monkeypatch):
         """lexsort_perm output is unchanged whichever engine runs."""

@@ -670,3 +670,38 @@ class TestDurationLiterals:
         ):
             out = self._q(session, tmp_path, q)
             assert out.num_rows == 0
+
+
+class TestParquetDictionaryGate:
+    """The dictionary opt-out gate must sample ACROSS the table: index
+    tables arrive key-sorted, so a prefix sample sees only the clustered
+    duplicates of the first few keys and would re-enable dictionary
+    encoding for globally high-cardinality key columns."""
+
+    def test_sorted_key_column_skips_dictionary(self):
+        import numpy as np
+        import pyarrow as pa
+
+        from hyperspace_tpu.io.parquet import _dictionary_columns
+
+        n = 400_000
+        # each key appears 8x, keys sorted: prefix of 4096 rows has only
+        # 512 distinct values, but globally there are 50k distinct
+        key = np.repeat(np.arange(n // 8, dtype=np.int64), 8)
+        low = np.tile(np.arange(30, dtype=np.int64), n // 30 + 1)[:n]
+        t = pa.table({"key": key, "day": low, "s": pa.array(["x"] * n)})
+        cols = _dictionary_columns(t)
+        assert "key" not in cols           # high-cardinality: no dict
+        assert "day" in cols               # low-cardinality: keep dict
+        assert "s" in cols                 # strings always keep dict
+
+    def test_empty_and_small_tables(self):
+        import numpy as np
+        import pyarrow as pa
+
+        from hyperspace_tpu.io.parquet import _dictionary_columns
+
+        empty = pa.table({"a": pa.array([], pa.int64())})
+        assert _dictionary_columns(empty) is False
+        small = pa.table({"a": np.zeros(100, dtype=np.int64)})
+        assert _dictionary_columns(small) == ["a"]
