@@ -164,6 +164,8 @@ def load(wait: bool = True):
                 ctypes.c_int64,
                 _i64p,
                 ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_int64,
                 _i64p,
                 _i64p,
             ]
@@ -219,6 +221,66 @@ def lexsort_u32(planes: np.ndarray) -> Optional[np.ndarray]:
     return out
 
 
+def merge_join_count_i64(
+    l_sorted: np.ndarray, r_sorted: np.ndarray
+) -> Optional[int]:
+    """Pair count of the inner join of two ASCENDING-sorted int64 key
+    arrays (one linear merge, no allocation), or None when the native
+    kernel is unavailable."""
+    lib = load(wait=False)
+    if lib is None:
+        return None
+    l_sorted = np.ascontiguousarray(l_sorted, dtype=np.int64)
+    r_sorted = np.ascontiguousarray(r_sorted, dtype=np.int64)
+    _i64p = ctypes.POINTER(ctypes.c_int64)
+    return lib.hs_merge_join_count_i64(
+        l_sorted.ctypes.data_as(_i64p),
+        len(l_sorted),
+        r_sorted.ctypes.data_as(_i64p),
+        len(r_sorted),
+    )
+
+
+def merge_join_emit_into(
+    l_sorted: np.ndarray,
+    r_sorted: np.ndarray,
+    li_out: np.ndarray,
+    ri_out: np.ndarray,
+    l_bias: int = 0,
+    r_bias: int = 0,
+) -> bool:
+    """Emit the join pairs (biased by l_bias/r_bias) into the caller's
+    preallocated CONTIGUOUS int64 slices, whose length must equal
+    ``merge_join_count_i64``'s result. Returns False when the native
+    kernel is unavailable or the emitted count mismatches."""
+    for out in (li_out, ri_out):
+        # the kernel writes int64 through the raw base pointer — a
+        # strided view or other dtype would be silently clobbered, so
+        # make the contract violation loud (programming error, not a
+        # fall-back condition)
+        if out.dtype != np.int64 or not out.flags.c_contiguous:
+            raise ValueError(
+                "merge_join_emit_into requires C-contiguous int64 outputs"
+            )
+    lib = load(wait=False)
+    if lib is None:
+        return False
+    l_sorted = np.ascontiguousarray(l_sorted, dtype=np.int64)
+    r_sorted = np.ascontiguousarray(r_sorted, dtype=np.int64)
+    _i64p = ctypes.POINTER(ctypes.c_int64)
+    emitted = lib.hs_merge_join_emit_i64(
+        l_sorted.ctypes.data_as(_i64p),
+        len(l_sorted),
+        r_sorted.ctypes.data_as(_i64p),
+        len(r_sorted),
+        ctypes.c_int64(l_bias),
+        ctypes.c_int64(r_bias),
+        li_out.ctypes.data_as(_i64p),
+        ri_out.ctypes.data_as(_i64p),
+    )
+    return emitted == len(li_out)
+
+
 def merge_join_i64(
     l_sorted: np.ndarray, r_sorted: np.ndarray
 ) -> Optional[tuple]:
@@ -227,24 +289,13 @@ def merge_join_i64(
     by left index then right index — identical to the numpy
     searchsorted + repeat expansion it replaces. Returns None when the
     native kernel is unavailable."""
-    lib = load(wait=False)
-    if lib is None:
+    total = merge_join_count_i64(l_sorted, r_sorted)
+    if total is None:
         return None
-    l_sorted = np.ascontiguousarray(l_sorted, dtype=np.int64)
-    r_sorted = np.ascontiguousarray(r_sorted, dtype=np.int64)
-    _i64p = ctypes.POINTER(ctypes.c_int64)
-    lp = l_sorted.ctypes.data_as(_i64p)
-    rp = r_sorted.ctypes.data_as(_i64p)
-    n, m = len(l_sorted), len(r_sorted)
-    total = lib.hs_merge_join_count_i64(lp, n, rp, m)
     li = np.empty(total, dtype=np.int64)
     ri = np.empty(total, dtype=np.int64)
-    if total:
-        emitted = lib.hs_merge_join_emit_i64(
-            lp, n, rp, m, li.ctypes.data_as(_i64p), ri.ctypes.data_as(_i64p)
-        )
-        if emitted != total:  # pragma: no cover — would be a kernel bug
-            return None
+    if total and not merge_join_emit_into(l_sorted, r_sorted, li, ri):
+        return None  # pragma: no cover — would be a kernel bug
     return li, ri
 
 

@@ -185,12 +185,15 @@ int64_t hs_merge_join_count_i64(const int64_t* l, int64_t n,
 }
 
 // Emit the matching pairs of two ASCENDING-sorted int64 key arrays into
-// li/ri (capacity = hs_merge_join_count_i64's result). Order: left index
-// ascending, right index ascending within each left row — identical to
-// the numpy searchsorted+repeat expansion it replaces.
+// li/ri (capacity = hs_merge_join_count_i64's result), with l_bias/r_bias
+// added to every emitted index. Order: left index ascending, right index
+// ascending within each left row — identical to the numpy
+// searchsorted+repeat expansion it replaces. The biases let a per-bucket
+// caller emit GLOBAL row ids straight into one preallocated output,
+// skipping the per-bucket offset-add and concatenate passes entirely.
 int64_t hs_merge_join_emit_i64(const int64_t* l, int64_t n,
-                               const int64_t* r, int64_t m, int64_t* li,
-                               int64_t* ri) {
+                               const int64_t* r, int64_t m, int64_t l_bias,
+                               int64_t r_bias, int64_t* li, int64_t* ri) {
   int64_t out = 0;
   int64_t i = 0, j = 0;
   while (i < n && j < m) {
@@ -204,8 +207,8 @@ int64_t hs_merge_join_emit_i64(const int64_t* l, int64_t n,
       while (j2 < m && r[j2] == v) ++j2;
       for (; i < n && l[i] == v; ++i) {
         for (int64_t jj = j; jj < j2; ++jj) {
-          li[out] = i;
-          ri[out] = jj;
+          li[out] = i + l_bias;
+          ri[out] = jj + r_bias;
           ++out;
         }
       }

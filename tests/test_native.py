@@ -150,6 +150,94 @@ class TestMergeJoinParity:
         )
 
 
+class TestPresortedFastPath:
+    """_host_match's all-buckets-presorted fast path (count + biased
+    emit-into) must return exactly what the per-bucket fallback loop
+    returns — including the loff/roff bias plumbing."""
+
+    def _preps(self, rng, n_left, n_right, n_buckets=4):
+        from hyperspace_tpu.execution.join_exec import prepare_join_side
+        from hyperspace_tpu.io.columnar import ColumnarBatch
+        from hyperspace_tpu.ops.hash import bucket_ids_host
+        from hyperspace_tpu.ops.sort import sort_permutation
+
+        def side(n):
+            keys = rng.integers(0, max(n // 4, 1), n).astype(np.int64)
+            batches = {}
+            reps = keys[None, :]
+            bids = bucket_ids_host(reps, n_buckets)
+            for b in range(n_buckets):
+                idx = np.nonzero(bids == b)[0]
+                if len(idx) == 0:
+                    continue
+                sub = keys[idx]
+                perm = sort_permutation(sub[None, :])
+                import pyarrow as pa
+
+                batches[b] = ColumnarBatch.from_arrow(
+                    pa.table({"k": sub[perm]})
+                )
+            return prepare_join_side(batches, ["k"])
+
+        return side(n_left), side(n_right)
+
+    def test_matches_fallback_loop(self, monkeypatch):
+        from hyperspace_tpu.execution import join_exec as je
+
+        pytest.importorskip("numpy")
+        if __import__("hyperspace_tpu.native", fromlist=["load"]).load() is None:
+            pytest.skip("native unavailable")
+        rng = np.random.default_rng(31)
+        lp, rp = self._preps(rng, 9000, 3000)
+        assert lp.sorted_buckets and rp.sorted_buckets
+        monkeypatch.setattr(je, "_NATIVE_JOIN_MIN_ROWS", 1)
+        fast = je._host_match_native_presorted(
+            lp, rp, lp.combined, rp.combined
+        )
+        assert fast is not None
+        # force the fallback loop by making the fast path unavailable
+        monkeypatch.setattr(
+            je, "_host_match_native_presorted", lambda *a: None
+        )
+        slow = je._host_match(lp, rp, lp.combined, rp.combined)
+        np.testing.assert_array_equal(fast[0], slow[0])
+        np.testing.assert_array_equal(fast[1], slow[1])
+        # the bias plumbing maps pairs to GLOBAL row ids: keys must match
+        np.testing.assert_array_equal(
+            lp.combined[fast[0]], rp.combined[fast[1]]
+        )
+
+    def test_empty_bucket_intersection(self, monkeypatch):
+        from hyperspace_tpu.execution import join_exec as je
+
+        if __import__("hyperspace_tpu.native", fromlist=["load"]).load() is None:
+            pytest.skip("native unavailable")
+        rng = np.random.default_rng(37)
+        lp, rp = self._preps(rng, 600, 500)
+        monkeypatch.setattr(je, "_NATIVE_JOIN_MIN_ROWS", 1)
+        # disjoint key ranges -> zero pairs through the fast path
+        lp2 = lp
+        rp2 = rp
+        shifted = rp.combined + np.int64(10**12)
+        fast = je._host_match_native_presorted(lp2, rp2, lp.combined, shifted)
+        assert fast is not None and len(fast[0]) == 0
+
+    def test_emit_into_validates_outputs(self):
+        from hyperspace_tpu import native
+
+        if native.load() is None:
+            pytest.skip("native unavailable")
+        ls = np.array([1, 2, 3], dtype=np.int64)
+        with pytest.raises(ValueError):
+            native.merge_join_emit_into(
+                ls, ls, np.empty(6, np.int32), np.empty(6, np.int64)
+            )
+        with pytest.raises(ValueError):
+            native.merge_join_emit_into(
+                ls, ls, np.empty(6, np.int64)[::2], np.empty(3, np.int64)
+            )
+
+
 class TestBucketIdsParity:
     def _check(self, reps, num_buckets, seed=42):
         import hyperspace_tpu.ops.hash as hash_mod
