@@ -426,28 +426,63 @@ def _exec_join(plan: Join, needed: Set[str], session) -> ColumnarBatch:
     return inner_join(left, right, on)
 
 
+def _joinside_cache_relations(plan):
+    """Relations whose combined file fingerprints key a cacheable
+    prepared join side, or None when the child's shape is not cacheable.
+
+    Two shapes qualify: a clean Project*(Scan) chain over an index scan
+    (index-only serve), and a clean Project*(Union(Project*(Scan),
+    Project*(Scan))) where the left is an index scan and the right is the
+    Hybrid-Scan APPEND compensation over immutable source files — keying
+    on both file sets means a further append (new file) or refresh (new
+    index version) changes the fingerprint and can never serve stale.
+    Delete compensation (excluded_file_ids / lineage filters) breaks the
+    shape and stays uncached."""
+
+    def walk(node):
+        while isinstance(node, Project):
+            node = node.child
+        return node
+
+    node = walk(plan)
+    if isinstance(node, Scan) and _cacheable_scan(node.relation):
+        return [node.relation]
+    if isinstance(node, Union):
+        left, right = walk(node.left), walk(node.right)
+        if (
+            isinstance(left, Scan)
+            and isinstance(right, Scan)
+            and _cacheable_scan(left.relation)
+            and right.relation.fmt in ("parquet", "delta", "iceberg")
+            and right.relation.excluded_file_ids is None
+            and not right.relation.file_partition_values
+            and bool(right.relation.files)
+        ):
+            return [left.relation, right.relation]
+    return None
+
+
 def _prepared_join_side(
     plan: LogicalPlan, needed: Set[str], session, bucket_cols, key_cols
 ):
     """A PreparedJoinSide for one co-bucketed join child, served from the
     serve cache when the child is a clean Project*(Scan) chain (the plan
-    shape of an index-only scan). Returns None for an empty side."""
+    shape of an index-only scan) or a Hybrid-Scan append union of two
+    such chains. Returns None for an empty side."""
     from hyperspace_tpu.execution.join_exec import prepare_join_side
 
     cache = _serve_cache(session)
     key = None
     if cache is not None:
-        node = plan
-        while isinstance(node, Project):
-            node = node.child
-        if isinstance(node, Scan) and _cacheable_scan(node.relation):
+        rels = _joinside_cache_relations(plan)
+        if rels is not None:
             from hyperspace_tpu.execution.serve_cache import file_fingerprint
 
-            fp = file_fingerprint(node.relation.files)
-            if fp is not None:
+            fps = tuple(file_fingerprint(r.files) for r in rels)
+            if None not in fps:
                 key = (
                     "joinside",
-                    fp,
+                    fps,
                     tuple(sorted(needed)),
                     tuple(key_cols),
                 )

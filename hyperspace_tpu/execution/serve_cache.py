@@ -21,9 +21,12 @@ path behaves exactly as before. What gets cached (see
 * ``("scan", fp)`` — per-COLUMN decoded data of a clean index scan
   (columns accrue across projections) + lazily-computed sorted-segment
   state for the binary-search point-lookup fast path;
-* ``("joinside", fp, cols, keys)`` — a ``PreparedJoinSide``
+* ``("joinside", fps, cols, keys)`` — a ``PreparedJoinSide``
   (``execution/join_exec.py``): concat batch, key reps, combined keys,
-  per-bucket offsets and sortedness;
+  per-bucket offsets and sortedness. ``fps`` is a TUPLE of per-relation
+  fingerprints: one for a clean index scan, two for the Hybrid-Scan
+  append union (index files + appended source files), so a further
+  append or refresh re-keys the entry;
 * ``("bucketed", fp, cols)`` — per-bucket batches for hybrid-scan serves.
 """
 

@@ -263,6 +263,70 @@ class TestCachedJoinDifferential:
         assert session.serve_cache.hits > 0
         session.conf.set(C.SERVE_CACHE_ENABLED, False)
 
+    def test_hybrid_joinside_cached_and_invalidated(self, session, hs, tmp_path):
+        """Repeated hybrid joins on a STABLE appended state hit the
+        joinside cache (keyed on index + appended file fingerprints);
+        a further append changes the fingerprint and serves fresh."""
+        df_o, df_i, src = self._mk(session, hs, tmp_path)
+        session.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, True)
+        session.conf.set(C.SERVE_CACHE_ENABLED, True)
+        session.enable_hyperspace()
+
+        def append(name, ks):
+            pq.write_table(
+                pa.table(
+                    {
+                        "k": pa.array(ks, type=pa.int64()),
+                        "d": pa.array(
+                            np.full(
+                                len(ks),
+                                np.datetime64("1998-01-01"),
+                                dtype="datetime64[D]",
+                            )
+                        ),
+                        "q": pa.array([7] * len(ks), type=pa.int64()),
+                        "p": pa.array([1.0] * len(ks)),
+                        "s": pa.array(["sH"] * len(ks)),
+                    }
+                ),
+                os.path.join(src, name),
+            )
+            session.index_manager.clear_cache()
+            return session.read.parquet(src)
+
+        df_i2 = append("hybrid-a.parquet", [3, 490])
+        plan = self._join(session, df_o, df_i2).explain()
+        assert plan.count("Hyperspace(Type: CI") == 2, plan
+        first = sorted_table(self._join(session, df_o, df_i2).collect())
+        hits0 = session.serve_cache.hits
+        again = sorted_table(self._join(session, df_o, df_i2).collect())
+        assert again.equals(first)
+        assert session.serve_cache.hits > hits0  # joinside served from RAM
+        # the UNION-shaped side must itself be cached: exactly the new
+        # behavior under test, pinned by its two-fingerprint key (a plain
+        # index-scan side's key has one fingerprint and hit before too)
+        union_keys = [
+            k
+            for k in session.serve_cache._entries
+            if k[0] == "joinside" and len(k[1]) == 2
+        ]
+        assert union_keys, "hybrid union joinside entry missing"
+        # differential against the unindexed engine on the same state
+        session.disable_hyperspace()
+        raw = sorted_table(self._join(session, df_o, df_i2).collect())
+        assert first.equals(raw)
+        session.enable_hyperspace()
+        # a FURTHER append must not serve the stale cached union
+        df_i3 = append("hybrid-b.parquet", [3])
+        more = sorted_table(self._join(session, df_o, df_i3).collect())
+        assert more.num_rows == first.num_rows + 1
+        session.disable_hyperspace()
+        raw3 = sorted_table(self._join(session, df_o, df_i3).collect())
+        assert more.equals(raw3)
+        session.enable_hyperspace()
+        session.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, False)
+        session.conf.set(C.SERVE_CACHE_ENABLED, False)
+
     def test_hybrid_scan_after_cache_populated(self, session, hs, tmp_path):
         df_o, df_i, src = self._mk(session, hs, tmp_path)
         session.conf.set(C.SERVE_CACHE_ENABLED, True)
