@@ -222,6 +222,36 @@ class TestPresortedFastPath:
         fast = je._host_match_native_presorted(lp2, rp2, lp.combined, shifted)
         assert fast is not None and len(fast[0]) == 0
 
+    def test_unsorted_bucket_branch_matches_searchsorted(self, monkeypatch):
+        """The in-loop native branch (argsorted buckets — multi-key or
+        hybrid tails) must equal the numpy searchsorted expansion."""
+        from hyperspace_tpu import native
+        from hyperspace_tpu.execution import join_exec as je
+
+        if native.load() is None:
+            pytest.skip("native unavailable")
+        import dataclasses
+
+        rng = np.random.default_rng(43)
+        lp, rp = self._preps(rng, 5000, 2000)
+        # GENUINELY unsorted buckets: shuffle each bucket's combined-key
+        # slice so the argsort inside _host_match is a real permutation
+        # (sorted data would make it the identity and leave the perm
+        # remap undiscriminated)
+        combined = lp.combined.copy()
+        for b in range(len(lp.sizes)):
+            s, c = int(lp.offs[b]), int(lp.sizes[b])
+            combined[s : s + c] = combined[s : s + c][rng.permutation(c)]
+        lp = dataclasses.replace(
+            lp, combined=combined, sorted_buckets=False
+        )
+        monkeypatch.setattr(je, "_NATIVE_JOIN_MIN_ROWS", 1)
+        with_native = je._host_match(lp, rp, lp.combined, rp.combined)
+        monkeypatch.setattr(native, "merge_join_i64", lambda *a: None)
+        without = je._host_match(lp, rp, lp.combined, rp.combined)
+        np.testing.assert_array_equal(with_native[0], without[0])
+        np.testing.assert_array_equal(with_native[1], without[1])
+
     def test_emit_into_validates_outputs(self):
         from hyperspace_tpu import native
 
