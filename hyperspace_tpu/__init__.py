@@ -33,7 +33,7 @@ Public API (mirrors reference ``Hyperspace.scala:27-193`` and
 
 from hyperspace_tpu.exceptions import HyperspaceException  # noqa: F401
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"
 
 # Lazy top-level convenience imports (PEP 562) to avoid import cycles and
 # keep `import hyperspace_tpu` cheap (no JAX import until a session is made).

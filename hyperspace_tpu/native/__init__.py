@@ -41,17 +41,34 @@ _lib = None
 _load_failed = False
 
 
+def _cache_dir() -> str:
+    """Directory for the compiled .so: next to the source when writable
+    (shared across users/processes, survives with the checkout), else a
+    per-user cache dir (read-only site-packages installs — root-owned
+    images, zipapp-adjacent layouts — must still get native kernels AND
+    a persistable .failed marker)."""
+    pkg = os.path.dirname(__file__)
+    if os.access(pkg, os.W_OK):
+        return pkg
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    path = os.path.join(base, "hyperspace_tpu", "native")
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
 def _cache_path() -> str:
     with open(_SRC, "rb") as f:
         digest = hashlib.sha256(f.read()).hexdigest()[:16]
-    return os.path.join(os.path.dirname(__file__), f"_hs_native_{digest}.so")
+    return os.path.join(_cache_dir(), f"_hs_native_{digest}.so")
 
 
 def _cleanup_superseded(keep: str) -> None:
     """Drop artifacts of older source revisions (the cache is keyed by a
     source hash, so every edit would otherwise strand one .so forever —
     a real leak on shared filesystems and baked images)."""
-    pattern = os.path.join(os.path.dirname(__file__), "_hs_native_*")
+    pattern = os.path.join(os.path.dirname(keep), "_hs_native_*")
     for old in glob.glob(pattern):
         # Never touch .tmp.<pid> files: on a shared filesystem another
         # process may be mid-compile of a DIFFERENT source revision, and
@@ -132,7 +149,14 @@ def load(wait: bool = True):
         if os.environ.get("HS_NATIVE", "1") == "0":
             _load_failed = True
             return None
-        path = _cache_path()
+        try:
+            path = _cache_path()
+        except OSError as exc:
+            # stripped install (no .cpp) or unusable cache dir: numpy
+            # fallback, never a crash on a query path
+            _log.warning("native kernels unavailable: %s", exc)
+            _load_failed = True
+            return None
         if not os.path.exists(path):
             if os.path.exists(path + ".failed"):
                 _log.warning(

@@ -337,3 +337,29 @@ class TestDispatch:
         monkeypatch.setattr(native, "_load_failed", False)
         assert native.load() is None
         assert native.lexsort_u32(np.zeros((1, 10), np.uint32)) is None
+
+    def test_missing_source_is_clean_fallback(self, monkeypatch):
+        """A stripped install (no .cpp) must latch the numpy fallback,
+        never raise out of load() into a query path."""
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_load_failed", False)
+        monkeypatch.setattr(native, "_SRC", "/nonexistent/hs_native.cpp")
+        assert native.load() is None
+        assert native._load_failed  # latched: no retry per call
+
+    def test_readonly_package_dir_uses_user_cache(
+        self, monkeypatch, tmp_path
+    ):
+        """Read-only site-packages compiles into XDG_CACHE_HOME instead."""
+        import os as _os
+
+        monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path))
+        pkg = _os.path.dirname(native._SRC)
+        real_access = _os.access
+        monkeypatch.setattr(
+            _os,
+            "access",
+            lambda p, m: False if p == pkg else real_access(p, m),
+        )
+        path = native._cache_path()
+        assert str(tmp_path) in path
