@@ -347,6 +347,60 @@ class TestDispatch:
         assert native.load() is None
         assert native._load_failed  # latched: no retry per call
 
+    def test_concurrent_first_compile(self, tmp_path):
+        """Several fresh processes racing the first-ever compile must all
+        end up with a working kernel (atomic tmp+rename publish); the
+        winner's .so is shared, losers' tmps vanish."""
+        import os
+        import shutil
+        import subprocess
+        import sys as _sys
+
+        # load() can succeed via an already-cached .so; the children must
+        # compile from scratch, so the compiler itself must exist
+        if native.load() is None or shutil.which("g++") is None:
+            pytest.skip("native toolchain unavailable")
+        script = (
+            "import sys, os\n"
+            f"sys.path.insert(0, {repr(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))})\n"
+            f"os.environ['XDG_CACHE_HOME'] = {repr(str(tmp_path))}\n"
+            "import numpy as np\n"
+            "from hyperspace_tpu import native\n"
+            # force the user-cache dir so this test never touches the
+            # repo's published .so
+            "pkg = os.path.dirname(native._SRC)\n"
+            "real = os.access\n"
+            "os.access = lambda p, m: False if p == pkg else real(p, m)\n"
+            "perm = native.lexsort_u32(\n"
+            "    np.array([[3, 1, 2]], dtype=np.uint32))\n"
+            "assert perm is not None and list(perm) == [1, 2, 0], perm\n"
+            "print('ok')\n"
+        )
+        procs = [
+            subprocess.Popen(
+                [_sys.executable, "-c", script],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+            )
+            for _ in range(4)
+        ]
+        try:
+            for p in procs:
+                out, err = p.communicate(timeout=300)
+                assert p.returncode == 0 and b"ok" in out, err[-500:]
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+        import glob as _glob
+
+        sos = _glob.glob(str(tmp_path / "hyperspace_tpu" / "native" / "*.so"))
+        tmps = _glob.glob(
+            str(tmp_path / "hyperspace_tpu" / "native" / "*.tmp.*")
+        )
+        assert len(sos) == 1 and not tmps, (sos, tmps)
+
     def test_readonly_package_dir_uses_user_cache(
         self, monkeypatch, tmp_path
     ):
