@@ -66,12 +66,20 @@ def gen_data(tmp: str, n_items: int, n_orders: int, n_files: int = 8):
     l_shipdate = base_date + rng.integers(0, 2400, n_items).astype("timedelta64[D]")
     l_quantity = rng.integers(1, 51, n_items, dtype=np.int64)
     l_extendedprice = rng.normal(30000, 8000, n_items)
+    # Rows are laid out in ship-date order before slicing into files, the
+    # natural layout of an append-mostly fact table (each file ≈ a date
+    # window). This gives the data-skipping bench real per-file min/max
+    # ranges to prune; l_orderkey stays uniform within every file, so the
+    # key-based filter/join benches are unaffected.
+    ship_order = np.argsort(l_shipdate, kind="stable")
     items = pa.table(
         {
-            "l_orderkey": l_orderkey,
-            "l_shipdate": pa.array(l_shipdate.astype("datetime64[D]")),
-            "l_quantity": l_quantity,
-            "l_extendedprice": l_extendedprice,
+            "l_orderkey": l_orderkey[ship_order],
+            "l_shipdate": pa.array(
+                l_shipdate[ship_order].astype("datetime64[D]")
+            ),
+            "l_quantity": l_quantity[ship_order],
+            "l_extendedprice": l_extendedprice[ship_order],
         }
     )
     o_orderkey = np.arange(n_orders, dtype=np.int64)
@@ -336,6 +344,138 @@ def main() -> None:
             f"{delta_refresh:.2f}s ({n_append / delta_refresh:,.0f} rows/s)"
         )
 
+        # --- z-order range query (the index kind had no perf row through
+        # round 5 — VERDICT weak #5). Two-dimensional range predicate; the
+        # z-layout clusters both dims so row-group min/max stats prune to
+        # a narrow band of each bucket file.
+        from hyperspace_tpu.indexes.dataskipping import DataSkippingIndexConfig
+        from hyperspace_tpu.indexes.sketches import MinMaxSketch
+        from hyperspace_tpu.indexes.zorder import ZOrderCoveringIndexConfig
+
+        session.conf.set(C.INDEX_LINEAGE_ENABLED, False)  # delta section left it on
+        session.index_manager.clear_cache()
+        items3 = session.read.parquet(items_dir)
+        hs.create_index(
+            items3,
+            ZOrderCoveringIndexConfig(
+                "z_idx", ["l_shipdate", "l_quantity"], ["l_orderkey"]
+            ),
+        )
+        zlo = np.datetime64("1995-06-01")
+        zhi = np.datetime64("1995-06-30")
+
+        def q_zrange(df):
+            return df.filter(
+                (df["l_shipdate"] >= zlo)
+                & (df["l_shipdate"] <= zhi)
+                & (df["l_quantity"] <= 5)
+            ).select("l_shipdate", "l_quantity", "l_orderkey")
+
+        session.enable_hyperspace()
+        plan = q_zrange(items3).explain()
+        if "Hyperspace(Type: ZOCI" not in plan:
+            log(f"WARNING: z-order range not index-served:\n{plan}")
+        z_rows = q_zrange(items3).collect().num_rows
+        zrange_idx = timeit(lambda: q_zrange(items3).collect(), reps)
+        session.disable_hyperspace()
+        assert q_zrange(items3).collect().num_rows == z_rows
+        zrange_raw = timeit(lambda: q_zrange(items3).collect(), reps)
+        log(
+            f"z-order range p50: indexed {zrange_idx['p50'] * 1e3:.1f}ms vs "
+            f"unindexed {zrange_raw['p50'] * 1e3:.1f}ms "
+            f"({zrange_raw['p50'] / zrange_idx['p50']:.2f}x, {z_rows:,} rows)"
+        )
+        # the z-index also covers l_shipdate and would win the scoring
+        # race below; the data-skipping row must measure DS serving
+        hs.delete_index("z_idx")
+        hs.vacuum_index("z_idx")
+
+        # --- data-skipping file pruning (min/max sketch; also had no
+        # perf row). Files are laid out in ship-date order, so a narrow
+        # date range prunes most source files from the scan itself.
+        session.index_manager.clear_cache()
+        items4 = session.read.parquet(items_dir)
+        hs.create_index(
+            items4, DataSkippingIndexConfig("ds_idx", MinMaxSketch("l_shipdate"))
+        )
+        session.enable_hyperspace()
+        plan = q_zrange(items4).explain()
+        if "Hyperspace(Type: DS" not in plan:
+            log(f"WARNING: data-skipping not serving:\n{plan}")
+        ds_leaves = session.optimize(
+            q_zrange(items4).logical_plan
+        ).collect_leaves()
+        ds_files = len(ds_leaves[0].relation.files)
+        ds_total = len(items4.logical_plan.collect_leaves()[0].relation.files)
+        ds_rows = q_zrange(items4).collect().num_rows
+        assert ds_rows == z_rows, (ds_rows, z_rows)
+        ds_idx_t = timeit(lambda: q_zrange(items4).collect(), reps)
+        session.disable_hyperspace()
+        ds_raw_t = timeit(lambda: q_zrange(items4).collect(), reps)
+        log(
+            f"data-skipping prune p50: indexed {ds_idx_t['p50'] * 1e3:.1f}ms "
+            f"({ds_files}/{ds_total} files scanned) vs unindexed "
+            f"{ds_raw_t['p50'] * 1e3:.1f}ms "
+            f"({ds_raw_t['p50'] / ds_idx_t['p50']:.2f}x)"
+        )
+        hs.delete_index("ds_idx")
+        hs.vacuum_index("ds_idx")
+
+        # --- build-throughput ladder: the scale story the BASELINE table
+        # tracks (4M/16M/64M). Each rung is an independent dataset +
+        # fresh index build; per-stage seconds name the bottleneck. The
+        # partition-first sort keeps per-bucket working sets resident, so
+        # the 64M rung no longer collapses on permutation gathers.
+        ladder_env = os.environ.get(
+            "HS_BENCH_LADDER", "4000000,16000000,64000000"
+        )
+        ladder = []
+        for rung_rows in [int(x) for x in ladder_env.split(",") if x.strip()]:
+            rung_dir = os.path.join(tmp, f"ladder_{rung_rows}")
+            try:
+                ldir, _odir = gen_data(
+                    rung_dir, rung_rows, max(rung_rows // 8, 1)
+                )
+                lsession = HyperspaceSession()
+                lsession.conf.set(
+                    C.INDEX_SYSTEM_PATH, os.path.join(rung_dir, "indexes")
+                )
+                lsession.conf.set(C.INDEX_NUM_BUCKETS, num_buckets)
+                lhs = Hyperspace(lsession)
+                ldf = lsession.read.parquet(ldir)
+                cfg = CoveringIndexConfig(
+                    "ladder_idx",
+                    ["l_orderkey"],
+                    ["l_shipdate", "l_quantity", "l_extendedprice"],
+                )
+                lhs.create_index(ldf, cfg)  # warm caches/compiles
+                lhs.delete_index("ladder_idx")
+                lhs.vacuum_index("ladder_idx")
+                lsession.index_manager.clear_cache()
+                t0 = time.perf_counter()
+                lhs.create_index(ldf, cfg)
+                rung_warm = time.perf_counter() - t0
+                rung_stages = {
+                    k: round(v, 3) for k, v in last_build_breakdown.items()
+                }
+                ladder.append(
+                    {
+                        "rows": rung_rows,
+                        "build_warm_s": round(rung_warm, 3),
+                        "build_rows_per_sec": round(rung_rows / rung_warm),
+                        "build_stage_seconds": rung_stages,
+                    }
+                )
+                log(
+                    f"ladder {rung_rows:,} rows: {rung_warm:.2f}s warm "
+                    f"({rung_rows / rung_warm:,.0f} rows/s); stages: "
+                    f"{rung_stages}"
+                )
+            except MemoryError:
+                log(f"ladder {rung_rows:,} rows: skipped (MemoryError)")
+            finally:
+                shutil.rmtree(rung_dir, ignore_errors=True)
+
         # headline: geometric mean of the three UNCACHED serve-path
         # speedups — stable under one path's unindexed baseline improving,
         # and directly comparable with rounds 1-4. The serve-server
@@ -405,6 +545,24 @@ def main() -> None:
                     "hybrid_index_served": hybrid_served,
                     "delta_incr_refresh_s": round(delta_refresh, 3),
                     "delta_refresh_rows_per_sec": round(n_append / delta_refresh),
+                    "zorder_range_indexed_p50_ms": ms(zrange_idx),
+                    "zorder_range_indexed_iqr_ms": iqr_ms(zrange_idx),
+                    "zorder_range_unindexed_p50_ms": ms(zrange_raw),
+                    "zorder_range_unindexed_iqr_ms": iqr_ms(zrange_raw),
+                    "zorder_range_speedup": round(
+                        zrange_raw["p50"] / zrange_idx["p50"], 3
+                    ),
+                    "zorder_range_rows_out": z_rows,
+                    "ds_prune_indexed_p50_ms": ms(ds_idx_t),
+                    "ds_prune_indexed_iqr_ms": iqr_ms(ds_idx_t),
+                    "ds_prune_unindexed_p50_ms": ms(ds_raw_t),
+                    "ds_prune_unindexed_iqr_ms": iqr_ms(ds_raw_t),
+                    "ds_prune_speedup": round(
+                        ds_raw_t["p50"] / ds_idx_t["p50"], 3
+                    ),
+                    "ds_prune_files_scanned": ds_files,
+                    "ds_prune_files_total": ds_total,
+                    "build_ladder": ladder,
                 }
             )
         )

@@ -85,6 +85,15 @@ class Config:
         )
 
     @property
+    def build_partition_first(self) -> bool:
+        """Partition-then-sort build pipeline (bit-identical to the
+        global lexsort it replaces; False = legacy path)."""
+        return self.get_bool(
+            C.INDEX_BUILD_PARTITION_FIRST,
+            C.INDEX_BUILD_PARTITION_FIRST_DEFAULT,
+        )
+
+    @property
     def lineage_enabled(self) -> bool:
         return self.get_bool(
             C.INDEX_LINEAGE_ENABLED, C.INDEX_LINEAGE_ENABLED_DEFAULT

@@ -104,6 +104,16 @@ EXPLAIN_DISPLAY_MODE_DEFAULT = "plaintext"
 INDEX_BUILD_MEMORY_BUDGET = "hyperspace.index.build.memoryBudgetBytes"
 INDEX_BUILD_MEMORY_BUDGET_DEFAULT = 0
 
+# Partition-first build sort: counting-scatter rows into per-bucket runs
+# first, then key-sort each bucket independently (working set ≈
+# rows/num_buckets) instead of one global lexsort by (bucket, keys) —
+# bit-identical output, fixes the 64M-row sort collapse (BASELINE.md:
+# permutation gathers walking a 512MB working set, TLB-bound). Off =
+# the legacy global lexsort, kept as a differential-test reference and
+# escape hatch.
+INDEX_BUILD_PARTITION_FIRST = "hyperspace.index.build.partitionFirst"
+INDEX_BUILD_PARTITION_FIRST_DEFAULT = True
+
 # Z-order (IndexConstants.scala:59-74)
 ZORDER_TARGET_SOURCE_BYTES_PER_PARTITION = (
     "hyperspace.index.zorder.targetSourceBytesPerPartition"

@@ -451,9 +451,12 @@ def _reassemble(spec, arrays: List[np.ndarray]) -> ColumnarBatch:
     return ColumnarBatch(cols)
 
 
-def bucketize(ctx, batch: ColumnarBatch, indexed_cols: List[str], num_buckets: int):
-    """Route rows to buckets -> (bucket_ids, batch) in bucket-grouped,
-    key-sorted order. Uses the mesh all-to-all when >1 device."""
+def _hash_shuffle(
+    ctx, batch: ColumnarBatch, indexed_cols: List[str], num_buckets: int
+):
+    """Bucket-id half of the pipeline: murmur3 bucket ids over the key
+    reps (+ mesh all-to-all when >1 device). Returns ``(buckets, reps,
+    batch)`` in post-exchange row order."""
     t0 = _time.perf_counter()
     reps = batch.key_reps(indexed_cols)
     mesh = ctx.mesh
@@ -470,8 +473,31 @@ def bucketize(ctx, batch: ColumnarBatch, indexed_cols: List[str], num_buckets: i
     else:
         buckets = bucket_ids_np(reps, num_buckets)
     _stage_add("hash_shuffle", t0)
+    return buckets, reps, batch
+
+
+def _partition_first(ctx) -> bool:
+    return ctx.session.conf.build_partition_first
+
+
+def bucketize(ctx, batch: ColumnarBatch, indexed_cols: List[str], num_buckets: int):
+    """Route rows to buckets -> (bucket_ids, batch) in bucket-grouped,
+    key-sorted order. Uses the mesh all-to-all when >1 device.
+
+    The sort half runs partition-first by default (stable counting
+    scatter into per-bucket runs, then per-bucket key sorts on a thread
+    pool — working set ≈ rows/num_buckets per sort) and produces a
+    permutation bit-identical to the legacy global lexsort by
+    (bucket, keys...) it replaces (``hyperspace.index.build.partitionFirst``
+    = false restores the old path)."""
+    from hyperspace_tpu.ops.sort import partitioned_sort_permutation
+
+    buckets, reps, batch = _hash_shuffle(ctx, batch, indexed_cols, num_buckets)
     t0 = _time.perf_counter()
-    perm = sort_permutation(reps, buckets)
+    if _partition_first(ctx):
+        perm = partitioned_sort_permutation(reps, buckets, num_buckets)
+    else:
+        perm = sort_permutation(reps, buckets)
     out = buckets[perm], batch.take(perm)
     _stage_add("sort", t0)
     return out
@@ -490,6 +516,11 @@ def write_bucketed(
     ``data`` is a ColumnarBatch, a :class:`SourceScan` (streamed in waves),
     or a list mixing both (incremental refresh: appended scan + rewritten
     old data).
+
+    The parquet dictionary-encoding decision is computed ONCE here, on
+    the pre-sort input, and passed to whichever writer runs — the legacy
+    and partition-first layouts must stay byte-identical, so they cannot
+    each sample a differently-ordered table.
     """
     import os
 
@@ -502,13 +533,95 @@ def write_bucketed(
     if batch.num_rows == 0:
         os.makedirs(ctx.index_data_path, exist_ok=True)
         return []
+    use_dict = pio.dictionary_columns_for_batch(batch)
+    if _partition_first(ctx):
+        return _write_bucketed_pipelined(
+            ctx, batch, indexed_cols, num_buckets, file_idx_offset, use_dict
+        )
     buckets, batch = bucketize(ctx, batch, indexed_cols, num_buckets)
     t0 = _time.perf_counter()
     out = pio.write_bucket_files(
-        ctx.index_data_path, buckets, batch, num_buckets, file_idx_offset
+        ctx.index_data_path,
+        buckets,
+        batch,
+        num_buckets,
+        file_idx_offset,
+        use_dictionary=use_dict,
     )
     _stage_add("write", t0)
     return out
+
+
+def _write_bucketed_pipelined(
+    ctx,
+    batch: ColumnarBatch,
+    indexed_cols: List[str],
+    num_buckets: int,
+    file_idx_offset: int,
+    use_dict,
+) -> List[str]:
+    """Partition-first, pipelined tail for in-memory builds.
+
+    1. counting-scatter rows into contiguous per-bucket runs (native
+       ``hs_partition_by_bucket``; sequential histogram + scatter);
+    2. per-bucket key lexsorts on a thread pool, bucket plane dropped
+       (constant within a bucket) — each sort's working set is ~one
+       bucket instead of the whole table, which is what collapsed the
+       64M-row global lexsort (BASELINE.md: TLB-bound gathers over
+       512MB);
+    3. bucket *i*'s parquet write runs on a writer thread while bucket
+       *i+1* is still sorting.
+
+    Output is bit-identical to the legacy global-lexsort layout: the
+    composed permutation equals the stable lexsort by (bucket, keys...)
+    and each file is written from the same rows in the same order with
+    the same encoding decision.
+
+    Stage accounting: "sort" spans partition + all per-bucket sorts;
+    "write" records only the drain after the last sort — the overlapped
+    portion of the writes hides inside the sort stage, which is the
+    point of the pipeline.
+
+    Datasets beyond the memory budget never reach here; they stream
+    through ``_write_bucketed_streaming``'s wave/spill loop, whose
+    per-wave ``bucketize`` uses the same partition-first sort.
+    """
+    import os
+    from concurrent.futures import ThreadPoolExecutor
+
+    from hyperspace_tpu.ops.sort import (
+        _order_words_np,
+        bucket_key_sort_runs,
+        partition_by_bucket,
+    )
+
+    buckets, reps, batch = _hash_shuffle(ctx, batch, indexed_cols, num_buckets)
+    os.makedirs(ctx.index_data_path, exist_ok=True)
+    t0 = _time.perf_counter()
+    order, offsets = partition_by_bucket(buckets, num_buckets)
+    planes = _order_words_np(reps.astype(np.int64, copy=False))
+    table = batch.to_arrow()
+    written: List[str] = []
+    with ThreadPoolExecutor(max_workers=1) as writer:
+        futures = []
+        for b, final_idx in bucket_key_sort_runs(planes, order, offsets):
+            futures.append(
+                writer.submit(
+                    pio.write_bucket_file,
+                    ctx.index_data_path,
+                    b,
+                    file_idx_offset,
+                    table,
+                    final_idx,
+                    use_dict,
+                )
+            )
+        _stage_add("sort", t0)
+        t0 = _time.perf_counter()
+        for f in futures:
+            written.append(f.result())
+    _stage_add("write", t0)
+    return written
 
 
 def _write_bucketed_streaming(

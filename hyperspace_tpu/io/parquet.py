@@ -362,34 +362,79 @@ def _dictionary_columns(table: pa.Table):
     return cols if cols else False
 
 
+def dictionary_columns_for_batch(batch: ColumnarBatch):
+    """The dictionary-encoding decision of ``_dictionary_columns``
+    computed from a strided sample of a :class:`ColumnarBatch` in its
+    CURRENT row order — the single decision point shared by both build
+    sort paths (legacy global lexsort and partition-first), computed on
+    the common pre-sort input so the two layouts stay byte-identical."""
+    n = batch.num_rows
+    if n > _DICT_SAMPLE_ROWS:
+        idx = np.linspace(0, n - 1, _DICT_SAMPLE_ROWS).astype(np.int64)
+        batch = batch.take(idx)
+    return _dictionary_columns(batch.to_arrow())
+
+
+def write_bucket_file(
+    out_dir: str,
+    bucket: int,
+    file_idx_offset: int,
+    table: pa.Table,
+    idx: np.ndarray,
+    use_dictionary,
+) -> str:
+    """One bucket's parquet file from rows ``idx`` of ``table`` — the
+    per-bucket unit of work of the pipelined partition-first writer
+    (``indexes/covering_build._write_bucketed_pipelined``) and of
+    :func:`write_bucket_files` below."""
+    path = os.path.join(out_dir, bucket_file_name(file_idx_offset + bucket, bucket))
+    if (
+        len(idx)
+        and len(idx) == int(idx[-1]) - int(idx[0]) + 1
+        and bool(np.all(idx[1:] > idx[:-1]))
+    ):
+        # contiguous ascending run (the globally sorted layout):
+        # zero-copy slice instead of a gather. The span test alone is not
+        # enough — a key-sorted bucket whose rows happen to occupy a
+        # contiguous pre-sort range (e.g. a mesh shuffle that already
+        # grouped by bucket) is a PERMUTATION of the span, not the span.
+        sub = table.slice(int(idx[0]), len(idx))
+    else:
+        sub = table.take(pa.array(idx))
+    pq.write_table(
+        sub,
+        path,
+        row_group_size=INDEX_ROW_GROUP_SIZE,
+        use_dictionary=use_dictionary,
+    )
+    return path
+
+
 def write_bucket_files(
     out_dir: str,
     bucket_ids: np.ndarray,
     batch: ColumnarBatch,
     num_buckets: int,
     file_idx_offset: int = 0,
+    use_dictionary=None,
 ) -> List[str]:
     """Write rows (already grouped/sorted, see ``parallel/shuffle.py`` +
-    ``ops/sort.py``) as one parquet file per non-empty bucket."""
+    ``ops/sort.py``) as one parquet file per non-empty bucket.
+    ``use_dictionary`` overrides the per-table encoding decision (the
+    build passes one decision computed on the pre-sort input so both
+    sort paths emit identical bytes)."""
     os.makedirs(out_dir, exist_ok=True)
     table = batch.to_arrow()
-    use_dict = _dictionary_columns(table)
+    use_dict = (
+        _dictionary_columns(table) if use_dictionary is None else use_dictionary
+    )
     written = []
     for b, idx in bucket_runs(bucket_ids):
-        path = os.path.join(out_dir, bucket_file_name(file_idx_offset + b, b))
-        if len(idx) == int(idx[-1]) - int(idx[0]) + 1:
-            # build sorts by (bucket, keys...), so bucket runs are
-            # contiguous: zero-copy slice instead of a gather
-            sub = table.slice(int(idx[0]), len(idx))
-        else:
-            sub = table.take(pa.array(idx))
-        pq.write_table(
-            sub,
-            path,
-            row_group_size=INDEX_ROW_GROUP_SIZE,
-            use_dictionary=use_dict,
+        written.append(
+            write_bucket_file(
+                out_dir, b, file_idx_offset, table, idx, use_dict
+            )
         )
-        written.append(path)
     return written
 
 

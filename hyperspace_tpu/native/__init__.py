@@ -29,7 +29,8 @@ import logging
 import os
 import subprocess
 import threading
-from typing import Optional
+import time as _time
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -117,18 +118,64 @@ def _compile(path: str) -> bool:
             pass
         stderr = getattr(exc, "stderr", b"") or b""
         detail = stderr.decode("utf-8", "replace")[-2000:] or str(exc)
+        # Transient failures (compiler timed out on a loaded machine,
+        # ENOSPC, OOM-killed g++) must NOT latch the machine-wide negative
+        # cache: this process falls back to numpy, the next one retries.
+        # Only a deterministic failure — a real compile error, or no g++
+        # on PATH at all (FileNotFoundError) — earns the marker; without
+        # it a toolchain-less machine would retry and warn in every
+        # process forever.
+        transient = isinstance(
+            exc, (OSError, subprocess.TimeoutExpired)
+        ) and not isinstance(exc, FileNotFoundError)
+        if isinstance(exc, subprocess.CalledProcessError):
+            # g++ killed by a signal (negative returncode: OOM killer on
+            # a loaded machine) or out of disk mid-write is transient
+            # too, even though both surface as CalledProcessError.
+            transient = exc.returncode < 0 or b"No space left" in stderr
         _log.warning(
-            "native kernel build failed; falling back to numpy twins "
-            "(delete %s.failed to retry): %s",
-            path,
+            "native kernel build failed; falling back to numpy twins%s: %s",
+            "" if transient else " (delete %s.failed to retry)" % path,
             detail,
         )
-        try:
-            with open(path + ".failed", "w") as f:
-                f.write(detail)
-        except OSError:
-            pass
+        if not transient:
+            try:
+                with open(path + ".failed", "w") as f:
+                    f.write(detail)
+            except OSError:
+                pass
         return False
+
+
+# How long a .failed negative-cache marker disables native kernels. A
+# marker older than this is treated as stale and the compile retried:
+# machines change (toolchain upgrades, freed disk), and a day-old latch
+# silently costing 3x on every sort is worse than one ~2s retry per day.
+_FAILED_MARKER_TTL_S = 24 * 3600.0
+
+
+def _failed_marker_fresh(marker: str) -> bool:
+    """True when the negative-cache marker exists and is young enough to
+    honor. Stale markers are removed (best effort) so the caller retries
+    the compile. TTL override: HS_NATIVE_FAILED_TTL (seconds)."""
+    try:
+        age = _time.time() - os.path.getmtime(marker)
+    except OSError:
+        return False
+    try:
+        ttl = float(
+            os.environ.get("HS_NATIVE_FAILED_TTL", _FAILED_MARKER_TTL_S)
+        )
+    except ValueError:
+        # a malformed override must not crash load() out of a query path
+        ttl = _FAILED_MARKER_TTL_S
+    if age <= ttl:
+        return True
+    try:
+        os.unlink(marker)
+    except OSError:
+        pass
+    return False
 
 
 def load(wait: bool = True):
@@ -158,7 +205,7 @@ def load(wait: bool = True):
             _load_failed = True
             return None
         if not os.path.exists(path):
-            if os.path.exists(path + ".failed"):
+            if _failed_marker_fresh(path + ".failed"):
                 _log.warning(
                     "native kernel disabled: previous build failed "
                     "(see %s.failed; delete it to retry)",
@@ -207,6 +254,15 @@ def load(wait: bool = True):
                 ctypes.c_uint32,
                 ctypes.POINTER(ctypes.c_int32),
             ]
+            lib.hs_partition_by_bucket.restype = ctypes.c_int
+            lib.hs_partition_by_bucket.argtypes = [
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int64,
+                ctypes.c_int32,
+                _i64p,
+                _i64p,
+                ctypes.c_int32,
+            ]
         except (OSError, AttributeError):
             _load_failed = True
             return None
@@ -216,19 +272,30 @@ def load(wait: bool = True):
         _lock.release()
 
 
-def _n_threads() -> int:
+def _cores() -> int:
     try:
-        cores = len(os.sched_getaffinity(0))
+        return len(os.sched_getaffinity(0))
     except (AttributeError, OSError):
-        cores = os.cpu_count() or 1
-    return max(1, min(cores, 16))
+        return os.cpu_count() or 1
 
 
-def lexsort_u32(planes: np.ndarray) -> Optional[np.ndarray]:
+def _n_threads(n: int) -> int:
+    """Thread count scaled to the input: one thread per ~64k rows, capped
+    by cores and 16. Just-above-threshold inputs (32k rows) would
+    otherwise pay 15 thread spawn/joins per byte pass for ~2k-row chunks
+    — more overhead than the whole numpy sort."""
+    return max(1, min(_cores(), 16, n >> 16))
+
+
+def lexsort_u32(
+    planes: np.ndarray, n_threads: Optional[int] = None
+) -> Optional[np.ndarray]:
     """Stable ascending lexsort permutation by uint32 ``planes`` [k, n]
     (plane 0 major) — bit-identical to ``np.lexsort(planes[::-1])``.
     Returns None when the native kernel is unavailable, so callers fall
-    back to numpy."""
+    back to numpy. ``n_threads`` overrides the size-scaled default — the
+    partitioned build runs many per-bucket sorts on its own pool and
+    gives each sort a slice of the core budget."""
     lib = load(wait=False)
     if lib is None:
         return None
@@ -243,11 +310,41 @@ def lexsort_u32(planes: np.ndarray) -> Optional[np.ndarray]:
         ctypes.c_int32(k),
         ctypes.c_int64(n),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-        ctypes.c_int32(_n_threads()),
+        ctypes.c_int32(n_threads if n_threads else _n_threads(n)),
     )
     if rc != 0:
         return None
     return out
+
+
+def partition_by_bucket_i32(
+    bucket_ids: np.ndarray, num_buckets: int
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Stable counting scatter of row indices by int32 bucket id:
+    ``(order, offsets)`` where ``order[offsets[b]:offsets[b+1]]`` holds
+    bucket ``b``'s row indices in original order — bit-identical to
+    ``np.argsort(bucket_ids, kind="stable")`` plus a bincount prefix sum
+    (the numpy twin, ``ops/sort.partition_by_bucket``). Returns None when
+    the native kernel is unavailable or the ids are malformed."""
+    lib = load(wait=False)
+    if lib is None:
+        return None
+    bucket_ids = np.ascontiguousarray(bucket_ids, dtype=np.int32)
+    n = len(bucket_ids)
+    order = np.empty(n, dtype=np.int64)
+    offsets = np.empty(num_buckets + 1, dtype=np.int64)
+    _i64p = ctypes.POINTER(ctypes.c_int64)
+    rc = lib.hs_partition_by_bucket(
+        bucket_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int64(n),
+        ctypes.c_int32(num_buckets),
+        order.ctypes.data_as(_i64p),
+        offsets.ctypes.data_as(_i64p),
+        ctypes.c_int32(_n_threads(n)),
+    )
+    if rc != 0:
+        return None
+    return order, offsets
 
 
 def merge_join_count_i64(

@@ -56,6 +56,26 @@ void pass_serial(Buffers& buf, int64_t n, int shift) {
   }
 }
 
+// Run fn(0..T-1), fn(0) on the calling thread. If a spawn fails
+// (std::system_error from pthread_create under a pids cgroup limit),
+// already-spawned threads are joined BEFORE the exception propagates —
+// destroying a joinable std::thread calls std::terminate, which would
+// abort the process instead of reaching the extern "C" catch(...) that
+// turns resource exhaustion into rc=2 / numpy fallback.
+template <typename F>
+void run_on_threads(int T, F&& fn) {
+  std::vector<std::thread> ts;
+  ts.reserve(T > 1 ? T - 1 : 0);
+  try {
+    for (int t = 1; t < T; ++t) ts.emplace_back(fn, t);
+  } catch (...) {
+    for (auto& th : ts) th.join();
+    throw;
+  }
+  fn(0);
+  for (auto& th : ts) th.join();
+}
+
 // Threaded variant: per-chunk histograms, then global offsets laid out
 // digit-major chunk-minor so each chunk scatters into disjoint, stably
 // ordered slots.
@@ -69,12 +89,7 @@ void pass_threaded(Buffers& buf, int64_t n, int shift, int n_threads) {
     int64_t* c = counts.data() + static_cast<size_t>(t) * 256;
     for (int64_t i = lo; i < hi; ++i) ++c[(ka[i] >> shift) & 0xFF];
   };
-  {
-    std::vector<std::thread> ts;
-    for (int t = 1; t < T; ++t) ts.emplace_back(hist, t);
-    hist(0);
-    for (auto& th : ts) th.join();
-  }
+  run_on_threads(T, hist);
   // offsets[t][d]: digit-major, chunk-minor prefix sum
   std::vector<int64_t> offsets(static_cast<size_t>(T) * 256);
   int64_t running = 0;
@@ -96,12 +111,7 @@ void pass_threaded(Buffers& buf, int64_t n, int shift, int n_threads) {
       pb[pos] = pa[i];
     }
   };
-  {
-    std::vector<std::thread> ts;
-    for (int t = 1; t < T; ++t) ts.emplace_back(scatter, t);
-    scatter(0);
-    for (auto& th : ts) th.join();
-  }
+  run_on_threads(T, scatter);
 }
 
 }  // namespace
@@ -110,7 +120,10 @@ extern "C" {
 
 // Stable ascending lexsort of n rows by k uint32 planes; planes[0] is
 // the MAJOR key. Writes the permutation into out (int64, length n).
-// Returns 0 on success, nonzero on bad arguments.
+// Returns 0 on success, 1 on bad arguments, 2 on resource exhaustion
+// (std::bad_alloc / thread spawn failure — the Python wrapper falls back
+// to numpy, whose MemoryError is catchable, instead of std::terminate
+// aborting the process at the extern "C" boundary).
 int hs_lexsort_u32(const uint32_t** planes, int32_t k, int64_t n,
                    int64_t* out, int32_t n_threads) {
   if (n < 0 || k < 0 || (n > 0 && out == nullptr)) return 1;
@@ -118,41 +131,113 @@ int hs_lexsort_u32(const uint32_t** planes, int32_t k, int64_t n,
   if (n <= 1 || k == 0) return 0;
   if (n_threads < 1) n_threads = 1;
 
-  Buffers buf;
-  buf.perm_a.resize(n);
-  buf.perm_b.resize(n);
-  buf.key_a.resize(n);
-  buf.key_b.resize(n);
-  std::memcpy(buf.perm_a.data(), out, static_cast<size_t>(n) * 8);
+  try {
+    Buffers buf;
+    buf.perm_a.resize(n);
+    buf.perm_b.resize(n);
+    buf.key_a.resize(n);
+    buf.key_b.resize(n);
+    std::memcpy(buf.perm_a.data(), out, static_cast<size_t>(n) * 8);
 
-  for (int p = k - 1; p >= 0; --p) {
-    const uint32_t* plane = planes[p];
-    // Byte-activity mask: a byte position where every row agrees cannot
-    // change the order — skip its pass. Order-independent, so it runs on
-    // the raw plane BEFORE paying the random gather; a constant plane
-    // (e.g. the hi word of small int64 keys) costs one sequential scan.
-    uint32_t mask = 0;
-    const uint32_t v0 = plane[0];
-    for (int64_t i = 1; i < n; ++i) mask |= plane[i] ^ v0;
-    if (mask == 0) continue;
-    // Gather the plane into the current permutation order (sequential
-    // writes; the random reads are the unavoidable cost of composing
-    // with the earlier planes' order).
-    const int64_t* pa = buf.perm_a.data();
-    uint32_t* ka = buf.key_a.data();
-    for (int64_t i = 0; i < n; ++i) ka[i] = plane[pa[i]];
-    for (int shift = 0; shift < 32; shift += 8) {
-      if (((mask >> shift) & 0xFF) == 0) continue;
-      if (n_threads > 1) {
-        pass_threaded(buf, n, shift, n_threads);
-      } else {
-        pass_serial(buf, n, shift);
+    for (int p = k - 1; p >= 0; --p) {
+      const uint32_t* plane = planes[p];
+      // Byte-activity mask: a byte position where every row agrees cannot
+      // change the order — skip its pass. Order-independent, so it runs on
+      // the raw plane BEFORE paying the random gather; a constant plane
+      // (e.g. the hi word of small int64 keys) costs one sequential scan.
+      uint32_t mask = 0;
+      const uint32_t v0 = plane[0];
+      for (int64_t i = 1; i < n; ++i) mask |= plane[i] ^ v0;
+      if (mask == 0) continue;
+      // Gather the plane into the current permutation order (sequential
+      // writes; the random reads are the unavoidable cost of composing
+      // with the earlier planes' order).
+      const int64_t* pa = buf.perm_a.data();
+      uint32_t* ka = buf.key_a.data();
+      for (int64_t i = 0; i < n; ++i) ka[i] = plane[pa[i]];
+      for (int shift = 0; shift < 32; shift += 8) {
+        if (((mask >> shift) & 0xFF) == 0) continue;
+        if (n_threads > 1) {
+          pass_threaded(buf, n, shift, n_threads);
+        } else {
+          pass_serial(buf, n, shift);
+        }
+        buf.perm_a.swap(buf.perm_b);
+        buf.key_a.swap(buf.key_b);
       }
-      buf.perm_a.swap(buf.perm_b);
-      buf.key_a.swap(buf.key_b);
     }
+    std::memcpy(out, buf.perm_a.data(), static_cast<size_t>(n) * 8);
+  } catch (...) {
+    return 2;
   }
-  std::memcpy(out, buf.perm_a.data(), static_cast<size_t>(n) * 8);
+  return 0;
+}
+
+// Stable counting scatter: partition n row indices by their int32 bucket
+// id. out_order receives the indices grouped bucket-major (ascending
+// bucket id), original order preserved within each bucket; out_offsets
+// (length num_buckets + 1) receives the run boundaries, so bucket b's
+// rows are out_order[out_offsets[b] .. out_offsets[b+1]).
+//
+// This is the partition-first half of the covering-index build: instead
+// of one global lexsort by (bucket, keys) whose permutation gathers walk
+// the whole working set, the build histograms bucket ids (sequential
+// read), scatters row indices into contiguous per-bucket runs
+// (sequential writes per bucket cursor), then sorts each bucket
+// independently with a working set of ~total/num_buckets.
+//
+// Returns 0 on success, 1 on bad arguments (including any bucket id
+// outside [0, num_buckets)), 2 on resource exhaustion.
+int hs_partition_by_bucket(const int32_t* bucket_ids, int64_t n,
+                           int32_t num_buckets, int64_t* out_order,
+                           int64_t* out_offsets, int32_t n_threads) {
+  if (n < 0 || num_buckets <= 0 || out_offsets == nullptr ||
+      (n > 0 && (bucket_ids == nullptr || out_order == nullptr)))
+    return 1;
+  if (n_threads < 1) n_threads = 1;
+  const int T = n_threads;
+  try {
+    // Per-chunk histograms (also validates ids: one branchy pass is
+    // cheaper than scattering through a poisoned offset table).
+    std::vector<int64_t> counts(static_cast<size_t>(T) * num_buckets, 0);
+    const int64_t chunk = T > 1 ? (n + T - 1) / T : n;
+    std::vector<uint8_t> bad(T, 0);
+    auto hist = [&](int t) {
+      int64_t lo = t * chunk, hi = std::min<int64_t>(n, lo + chunk);
+      int64_t* c = counts.data() + static_cast<size_t>(t) * num_buckets;
+      for (int64_t i = lo; i < hi; ++i) {
+        const int32_t b = bucket_ids[i];
+        if (b < 0 || b >= num_buckets) {
+          bad[t] = 1;
+          return;
+        }
+        ++c[b];
+      }
+    };
+    run_on_threads(T, hist);
+    for (int t = 0; t < T; ++t)
+      if (bad[t]) return 1;
+    // Bucket-major chunk-minor offsets: chunk t's slots for bucket b
+    // follow chunk t-1's, so the scatter is stable across chunks.
+    std::vector<int64_t> offsets(static_cast<size_t>(T) * num_buckets);
+    int64_t running = 0;
+    for (int32_t b = 0; b < num_buckets; ++b) {
+      out_offsets[b] = running;
+      for (int t = 0; t < T; ++t) {
+        offsets[static_cast<size_t>(t) * num_buckets + b] = running;
+        running += counts[static_cast<size_t>(t) * num_buckets + b];
+      }
+    }
+    out_offsets[num_buckets] = running;
+    auto scatter = [&](int t) {
+      int64_t lo = t * chunk, hi = std::min<int64_t>(n, lo + chunk);
+      int64_t* off = offsets.data() + static_cast<size_t>(t) * num_buckets;
+      for (int64_t i = lo; i < hi; ++i) out_order[off[bucket_ids[i]]++] = i;
+    };
+    run_on_threads(T, scatter);
+  } catch (...) {
+    return 2;
+  }
   return 0;
 }
 

@@ -101,12 +101,37 @@ def _bucket_ids_words(words, num_buckets: int, seed: int):
 # host at 4M rows: transfer dominates at every practical size). The
 # device kernel's home is HBM-resident data on a sharded mesh
 # (parallel/shuffle.py), not host-resident builds.
-_HOST_HASH_MAX_ROWS = 1 << 26
+#
+# FALLBACK DEFAULT: the effective threshold comes from the per-machine
+# calibration probe (hyperspace_tpu/native/calibrate.py) when available;
+# this constant applies when calibration is disabled (HS_CALIBRATE=0) or
+# when a test overrides the module attribute (an override always wins).
+_HOST_HASH_MAX_ROWS_DEFAULT = 1 << 26
+_HOST_HASH_MAX_ROWS = _HOST_HASH_MAX_ROWS_DEFAULT
 
 # At or above this row count the host hash uses the native single-pass
 # murmur3 kernel (hyperspace_tpu/native); below it numpy's vectorized
-# mixes are already microseconds.
-_NATIVE_HASH_MIN_ROWS = 1 << 15
+# mixes are already microseconds. Fallback default; see above.
+_NATIVE_HASH_MIN_ROWS_DEFAULT = 1 << 15
+_NATIVE_HASH_MIN_ROWS = _NATIVE_HASH_MIN_ROWS_DEFAULT
+
+
+def _host_hash_max_rows() -> int:
+    if _HOST_HASH_MAX_ROWS != _HOST_HASH_MAX_ROWS_DEFAULT:
+        return _HOST_HASH_MAX_ROWS  # explicit (test/ops) override wins
+    from hyperspace_tpu.native import calibrate
+
+    return calibrate.thresholds().host_hash_max_rows or _HOST_HASH_MAX_ROWS
+
+
+def _native_hash_min_rows() -> int:
+    if _NATIVE_HASH_MIN_ROWS != _NATIVE_HASH_MIN_ROWS_DEFAULT:
+        return _NATIVE_HASH_MIN_ROWS
+    from hyperspace_tpu.native import calibrate
+
+    return (
+        calibrate.thresholds().native_hash_min_rows or _NATIVE_HASH_MIN_ROWS
+    )
 
 
 def bucket_ids_host(
@@ -118,7 +143,7 @@ def bucket_ids_host(
     n = key_reps.shape[1]
     if n == 0:
         return np.zeros(0, dtype=np.int32)
-    if n >= _NATIVE_HASH_MIN_ROWS:
+    if n >= _native_hash_min_rows():
         from hyperspace_tpu import native
 
         # one pass per row vs ~10 vectorized passes; bit-exact twin
@@ -127,6 +152,20 @@ def bucket_ids_host(
         )
         if ids is not None:
             return ids
+    return bucket_ids_numpy(key_reps, num_buckets, seed)
+
+
+def bucket_ids_numpy(
+    key_reps: np.ndarray, num_buckets: int, seed: int = 42
+) -> np.ndarray:
+    """The pure-numpy murmur leg of :func:`bucket_ids_host`, never
+    dispatching to the native kernel — also the reference the
+    calibration probe (native/calibrate.py) times the native kernel
+    against, so the probe always measures exactly the code that runs
+    when the native kernel loses or is unavailable."""
+    n = key_reps.shape[1]
+    if n == 0:
+        return np.zeros(0, dtype=np.int32)
     words = split_words_np(key_reps)
     with np.errstate(over="ignore"):
         h = np.full(n, np.uint32(seed))
@@ -143,7 +182,7 @@ def bucket_ids_np(key_reps: np.ndarray, num_buckets: int, seed: int = 42) -> np.
     n = key_reps.shape[1]
     if n == 0:
         return np.zeros(0, dtype=np.int32)
-    if n <= _HOST_HASH_MAX_ROWS:
+    if n <= _host_hash_max_rows():
         return bucket_ids_host(key_reps, num_buckets, seed)
     words = split_words_np(key_reps)
     n_pad = pad_len(n)

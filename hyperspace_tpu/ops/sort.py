@@ -31,15 +31,60 @@ _SIGN = np.uint32(0x80000000)
 # numpy (radix) vs 3.7s device incl. transfer — the device kernel's home
 # is HBM-resident data on a sharded mesh, not host-resident builds, so
 # the host path covers every practical single-host size.
-_HOST_SORT_MAX_ROWS = 1 << 26
+#
+# FALLBACK DEFAULT: the effective threshold comes from the per-machine
+# calibration probe (hyperspace_tpu/native/calibrate.py) when available;
+# this constant is used when calibration is disabled (HS_CALIBRATE=0),
+# has not produced a measurement, or when a test overrides the module
+# attribute directly (an override always wins — see _host_sort_max_rows).
+_HOST_SORT_MAX_ROWS_DEFAULT = 1 << 26
+_HOST_SORT_MAX_ROWS = _HOST_SORT_MAX_ROWS_DEFAULT
 
 # At or above this row count the host path prefers the native C++ radix
 # lexsort (hyperspace_tpu/native): one adaptive LSD radix over all planes
 # with constant-byte pass skipping, measured 3.3x over np.lexsort at the
 # 4M-row bench shape (bit-identical stable output). Below it numpy's
 # overhead is already microseconds and a first native call would pay the
-# one-time g++ compile for nothing.
-_NATIVE_SORT_MIN_ROWS = 1 << 15
+# one-time g++ compile for nothing. Fallback default; see above.
+_NATIVE_SORT_MIN_ROWS_DEFAULT = 1 << 15
+_NATIVE_SORT_MIN_ROWS = _NATIVE_SORT_MIN_ROWS_DEFAULT
+
+# Same idea for the counting-scatter partition kernel. Its crossover is
+# NOT the lexsort's: the kernel is O(n) with two sequential passes and
+# near-zero per-row work, so ctypes/threading overhead amortizes much
+# earlier than for the radix sort. Calibrated separately (see
+# native/calibrate.py); fallback default below.
+_NATIVE_PARTITION_MIN_ROWS_DEFAULT = 1 << 15
+_NATIVE_PARTITION_MIN_ROWS = _NATIVE_PARTITION_MIN_ROWS_DEFAULT
+
+
+def _host_sort_max_rows() -> int:
+    if _HOST_SORT_MAX_ROWS != _HOST_SORT_MAX_ROWS_DEFAULT:
+        return _HOST_SORT_MAX_ROWS  # explicit (test/ops) override wins
+    from hyperspace_tpu.native import calibrate
+
+    return calibrate.thresholds().host_sort_max_rows or _HOST_SORT_MAX_ROWS
+
+
+def _native_sort_min_rows() -> int:
+    if _NATIVE_SORT_MIN_ROWS != _NATIVE_SORT_MIN_ROWS_DEFAULT:
+        return _NATIVE_SORT_MIN_ROWS
+    from hyperspace_tpu.native import calibrate
+
+    return (
+        calibrate.thresholds().native_sort_min_rows or _NATIVE_SORT_MIN_ROWS
+    )
+
+
+def _native_partition_min_rows() -> int:
+    if _NATIVE_PARTITION_MIN_ROWS != _NATIVE_PARTITION_MIN_ROWS_DEFAULT:
+        return _NATIVE_PARTITION_MIN_ROWS
+    from hyperspace_tpu.native import calibrate
+
+    return (
+        calibrate.thresholds().native_partition_min_rows
+        or _NATIVE_PARTITION_MIN_ROWS
+    )
 
 
 def _order_words_np(key_reps: np.ndarray) -> np.ndarray:
@@ -60,7 +105,11 @@ def lexsort_indices(word_planes):
     return jnp.lexsort(word_planes[::-1])
 
 
-def lexsort_perm(planes: np.ndarray, n_valid: int | None = None) -> np.ndarray:
+def lexsort_perm(
+    planes: np.ndarray,
+    n_valid: int | None = None,
+    n_threads: int | None = None,
+) -> np.ndarray:
     """Host dispatch of :func:`lexsort_indices` at a padded static shape.
 
     Pads the row dimension to ``pad_len`` with ``0xFFFFFFFF`` in every
@@ -69,6 +118,10 @@ def lexsort_perm(planes: np.ndarray, n_valid: int | None = None) -> np.ndarray:
     planes and ``jnp.lexsort`` is stable, so a real row that ties still
     precedes them (its index is smaller). The first ``n_valid`` outputs
     are therefore exactly the sorted real rows.
+
+    ``n_threads`` caps the native kernel's thread count — the partitioned
+    build runs many per-bucket sorts concurrently and hands each a slice
+    of the core budget instead of letting every sort claim the machine.
     """
     from hyperspace_tpu.ops import pad_len
 
@@ -76,13 +129,13 @@ def lexsort_perm(planes: np.ndarray, n_valid: int | None = None) -> np.ndarray:
     if n == 0:
         return np.zeros(0, dtype=np.int64)
     planes = planes.astype(np.uint32, copy=False)
-    if planes.shape[1] <= _HOST_SORT_MAX_ROWS:
+    if planes.shape[1] <= _host_sort_max_rows():
         # host lexsort: same stable semantics, no device round trip
         # (host-resident serve batches pay transfer + readback otherwise)
-        if planes.shape[1] >= _NATIVE_SORT_MIN_ROWS:
+        if planes.shape[1] >= _native_sort_min_rows():
             from hyperspace_tpu import native
 
-            perm = native.lexsort_u32(planes)
+            perm = native.lexsort_u32(planes, n_threads=n_threads)
             if perm is not None:
                 return perm[:n]
         return np.lexsort(planes[::-1])[:n]
@@ -107,6 +160,114 @@ def sort_permutation(
             [bucket.astype(np.uint32)[None, :], planes]
         )
     return lexsort_perm(planes)
+
+
+# ---------------------------------------------------------------------------
+# Partition-first build sort (locality-aware alternative to the global
+# (bucket, keys) lexsort — the 64M-row sort collapse fix)
+# ---------------------------------------------------------------------------
+
+
+def partition_by_bucket(
+    bucket_ids: np.ndarray, num_buckets: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Stable partition of row indices by bucket id: ``(order, offsets)``
+    with bucket ``b``'s rows at ``order[offsets[b]:offsets[b+1]]`` in
+    original order. Native counting-scatter kernel
+    (``hs_partition_by_bucket``: sequential histogram + per-cursor
+    sequential writes) above the native dispatch threshold, bit-exact
+    numpy twin (stable argsort + bincount prefix sum) below or when the
+    kernel is unavailable."""
+    bucket_ids = np.ascontiguousarray(bucket_ids, dtype=np.int32)
+    n = len(bucket_ids)
+    if n >= _native_partition_min_rows():
+        from hyperspace_tpu import native
+
+        got = native.partition_by_bucket_i32(bucket_ids, num_buckets)
+        if got is not None:
+            return got
+    return partition_by_bucket_numpy(bucket_ids, num_buckets)
+
+
+def partition_by_bucket_numpy(
+    bucket_ids: np.ndarray, num_buckets: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """The pure-numpy leg of :func:`partition_by_bucket` (stable argsort
+    + bincount prefix sum), never dispatching to the native kernel —
+    also the reference the calibration probe times the native
+    counting-scatter against."""
+    counts = np.bincount(bucket_ids, minlength=num_buckets)
+    offsets = np.concatenate(
+        [np.zeros(1, dtype=np.int64), np.cumsum(counts, dtype=np.int64)]
+    )
+    return np.argsort(bucket_ids, kind="stable").astype(np.int64), offsets
+
+
+def _sort_pool_plan(n_buckets: int) -> tuple[int, int]:
+    """(pool workers, native threads per sort) splitting the core budget
+    across concurrent per-bucket sorts."""
+    from hyperspace_tpu import native
+
+    budget = max(1, min(native._cores(), 16))
+    workers = max(1, min(budget, n_buckets))
+    return workers, max(1, budget // workers)
+
+
+def bucket_key_sort_runs(planes: np.ndarray, order: np.ndarray, offsets: np.ndarray):
+    """Per-bucket stable key sorts over a partitioned order — yields
+    ``(bucket, final_indices)`` in ascending bucket id as each bucket's
+    sort completes, running the sorts on a thread pool.
+
+    ``planes`` are the key order-words in ORIGINAL row order; bucket
+    ``b``'s rows are gathered (``planes[:, idx]``, a working set of ~one
+    bucket instead of the whole table) and lexsorted WITHOUT the bucket
+    plane (constant within a bucket). Ties keep ``idx`` order, and
+    ``idx`` is ascending, so ``idx[perm]`` reproduces exactly the global
+    stable lexsort by (bucket, keys...) restricted to bucket ``b``.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    nonempty = [
+        b for b in range(len(offsets) - 1) if offsets[b + 1] > offsets[b]
+    ]
+    if not nonempty:
+        return
+    workers, threads = _sort_pool_plan(len(nonempty))
+
+    def sort_one(b: int) -> np.ndarray:
+        idx = order[offsets[b] : offsets[b + 1]]
+        perm = lexsort_perm(
+            np.ascontiguousarray(planes[:, idx]), n_threads=threads
+        )
+        return idx[perm]
+
+    if workers == 1:
+        for b in nonempty:
+            yield b, sort_one(b)
+        return
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        futures = [(b, pool.submit(sort_one, b)) for b in nonempty]
+        for b, fut in futures:
+            yield b, fut.result()
+
+
+def partitioned_sort_permutation(
+    key_reps: np.ndarray, bucket: np.ndarray, num_buckets: int
+) -> np.ndarray:
+    """Bit-identical to ``sort_permutation(key_reps, bucket)`` (stable
+    lexsort by (bucket, keys...)) computed partition-first: one counting
+    scatter groups rows by bucket, then each bucket is key-sorted
+    independently on a thread pool with a working set of
+    ~rows/num_buckets. The 64M-row global lexsort's permutation gathers
+    walk the entire multi-hundred-MB working set per radix pass
+    (TLB-bound — BASELINE.md); per-bucket sorts keep each pass resident.
+    """
+    order, offsets = partition_by_bucket(bucket, num_buckets)
+    planes = _order_words_np(key_reps.astype(np.int64, copy=False))
+    out = np.empty(len(order), dtype=np.int64)
+    for b, final_idx in bucket_key_sort_runs(planes, order, offsets):
+        out[offsets[b] : offsets[b + 1]] = final_idx
+    return out
 
 
 # ---------------------------------------------------------------------------

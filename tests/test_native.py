@@ -6,6 +6,8 @@ sort — because ``ops/sort.lexsort_perm`` relies on stability for the
 pad-row trick and bucketed writes rely on deterministic run order.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -313,6 +315,279 @@ class TestBucketIdsParity:
             for i in range(0, n, 1000)
         ]
         np.testing.assert_array_equal(big, np.concatenate(small_parts))
+
+
+class TestPartitionKernelParity:
+    """hs_partition_by_bucket vs the numpy twin (stable argsort +
+    bincount prefix sum) — bit-exact, same offsets."""
+
+    def _check(self, bids, nb):
+        bids = np.ascontiguousarray(bids, dtype=np.int32)
+        got = native.partition_by_bucket_i32(bids, nb)
+        assert got is not None
+        order, offsets = got
+        np.testing.assert_array_equal(order, np.argsort(bids, kind="stable"))
+        np.testing.assert_array_equal(
+            np.diff(offsets), np.bincount(bids, minlength=nb)
+        )
+        assert offsets[0] == 0 and offsets[-1] == len(bids)
+
+    def test_empty_and_tiny(self):
+        self._check(np.zeros(0, dtype=np.int32), 4)
+        self._check(np.array([0]), 1)
+        self._check(np.array([2, 0, 2, 1]), 3)
+
+    @pytest.mark.parametrize("nb", [1, 8, 200])
+    @pytest.mark.parametrize("n", [100, 100_003, 1 << 18])
+    def test_random(self, n, nb):
+        rng = np.random.default_rng(n + nb)
+        self._check(rng.integers(0, nb, n), nb)
+
+    def test_skewed_single_bucket(self):
+        # every row in one bucket: one cursor does all the writes
+        self._check(np.full(50_000, 3, dtype=np.int32), 8)
+
+    def test_out_of_range_ids_rejected(self):
+        assert (
+            native.partition_by_bucket_i32(np.array([0, 9], dtype=np.int32), 4)
+            is None
+        )
+        assert (
+            native.partition_by_bucket_i32(np.array([-1], dtype=np.int32), 4)
+            is None
+        )
+
+
+class TestThreadScaling:
+    def test_n_threads_scales_with_input(self):
+        """Small inputs must not spawn a full thread complement
+        (ADVICE round 5: 15 spawn/joins per byte pass at 33k rows)."""
+        assert native._n_threads(0) == 1
+        assert native._n_threads(1 << 15) == 1  # just above dispatch min
+        assert native._n_threads(1 << 16) == 1
+        assert native._n_threads(1 << 17) <= 2
+        big = native._n_threads(1 << 30)
+        assert big <= min(native._cores(), 16)
+
+
+class TestFailedMarkerPolicy:
+    def test_fresh_marker_honored_stale_removed(self, tmp_path):
+        marker = str(tmp_path / "x.so.failed")
+        with open(marker, "w") as f:
+            f.write("boom")
+        assert native._failed_marker_fresh(marker)
+        # age it past the TTL: the marker is dropped and compile retried
+        old = native._time.time() - 2 * native._FAILED_MARKER_TTL_S
+        os.utime(marker, (old, old))
+        assert not native._failed_marker_fresh(marker)
+        assert not os.path.exists(marker)
+
+    def test_missing_marker(self, tmp_path):
+        assert not native._failed_marker_fresh(str(tmp_path / "none.failed"))
+
+    def test_transient_compile_failure_writes_no_marker(
+        self, tmp_path, monkeypatch
+    ):
+        """TimeoutExpired / OSError must not latch the machine-wide
+        negative cache (one loaded-machine timeout would disable native
+        kernels until an operator intervened)."""
+        import subprocess as sp
+
+        target = str(tmp_path / "k.so")
+
+        def boom_timeout(*a, **k):
+            raise sp.TimeoutExpired(cmd="g++", timeout=300)
+
+        monkeypatch.setattr(native.subprocess, "run", boom_timeout)
+        assert not native._compile(target)
+        assert not os.path.exists(target + ".failed")
+
+        def boom_compile(*a, **k):
+            raise sp.CalledProcessError(1, "g++", stderr=b"syntax error")
+
+        monkeypatch.setattr(native.subprocess, "run", boom_compile)
+        assert not native._compile(target)
+        assert os.path.exists(target + ".failed")
+
+    def test_signal_killed_compiler_writes_no_marker(
+        self, tmp_path, monkeypatch
+    ):
+        """g++ OOM-killed on a loaded machine (negative returncode) is
+        transient: no marker, the next process retries."""
+        import subprocess as sp
+
+        target = str(tmp_path / "k.so")
+
+        def boom_sigkill(*a, **k):
+            raise sp.CalledProcessError(-9, "g++", stderr=b"")
+
+        monkeypatch.setattr(native.subprocess, "run", boom_sigkill)
+        assert not native._compile(target)
+        assert not os.path.exists(target + ".failed")
+
+    def test_missing_compiler_writes_marker(self, tmp_path, monkeypatch):
+        """No g++ on PATH is deterministic, not transient: it earns the
+        marker so a toolchain-less machine doesn't re-attempt the
+        compile and warn in every process forever."""
+        target = str(tmp_path / "k.so")
+
+        def boom_missing(*a, **k):
+            raise FileNotFoundError("g++: command not found")
+
+        monkeypatch.setattr(native.subprocess, "run", boom_missing)
+        assert not native._compile(target)
+        assert os.path.exists(target + ".failed")
+
+
+class TestCalibration:
+    """Dispatch thresholds come from the cached per-machine probe; the
+    ops constants are only the fallback (calibration disabled / no
+    measurement / explicit override)."""
+
+    @pytest.fixture(autouse=True)
+    def fresh(self, tmp_path, monkeypatch):
+        from hyperspace_tpu.native import calibrate
+
+        monkeypatch.setattr(native, "_cache_dir", lambda: str(tmp_path))
+        calibrate.invalidate()
+        yield
+        calibrate.invalidate()
+
+    def test_probe_result_is_cached_to_disk(self, tmp_path, monkeypatch):
+        import json
+
+        from hyperspace_tpu.native import calibrate
+
+        probed = calibrate.Thresholds(
+            host_sort_max_rows=calibrate._NEVER,
+            native_sort_min_rows=8192,
+            host_hash_max_rows=calibrate._NEVER,
+            native_hash_min_rows=4096,
+            source="calibrated",
+        )
+        monkeypatch.setattr(calibrate, "_probe", lambda: probed)
+        got = calibrate.thresholds()
+        assert got.source == "calibrated"
+        assert got.native_sort_min_rows == 8192
+        with open(tmp_path / "_hs_calibration.json") as f:
+            data = json.load(f)
+        assert data["thresholds"]["native_sort_min_rows"] == 8192
+        # a later process (fresh memo) reads the file, never re-probes
+        calibrate.invalidate()
+        monkeypatch.setattr(
+            calibrate, "_probe", lambda: (_ for _ in ()).throw(AssertionError)
+        )
+        assert calibrate.thresholds().native_sort_min_rows == 8192
+
+    def test_machine_key_mismatch_reprobes(self, monkeypatch):
+        from hyperspace_tpu.native import calibrate
+
+        monkeypatch.setattr(
+            calibrate,
+            "_probe",
+            lambda: calibrate.Thresholds(
+                native_sort_min_rows=1024, source="calibrated"
+            ),
+        )
+        calibrate.thresholds()
+        calibrate.invalidate()
+        monkeypatch.setattr(
+            calibrate, "_machine_key", lambda: {"version": -1, "cpus": 0}
+        )
+        monkeypatch.setattr(
+            calibrate,
+            "_probe",
+            lambda: calibrate.Thresholds(
+                native_sort_min_rows=2048, source="calibrated"
+            ),
+        )
+        assert calibrate.thresholds().native_sort_min_rows == 2048
+
+    def test_disabled_returns_defaults(self, monkeypatch):
+        from hyperspace_tpu.native import calibrate
+
+        monkeypatch.setenv("HS_CALIBRATE", "0")
+        got = calibrate.thresholds()
+        assert got.source == "defaults"
+        assert got.native_sort_min_rows == 0  # 0 = use the ops constant
+
+    def test_ops_fall_back_to_constants_when_disabled(self, monkeypatch):
+        from hyperspace_tpu.native import calibrate
+        from hyperspace_tpu.ops import hash as hash_mod
+        from hyperspace_tpu.ops import sort as sort_mod
+
+        monkeypatch.setenv("HS_CALIBRATE", "0")
+        assert sort_mod._host_sort_max_rows() == sort_mod._HOST_SORT_MAX_ROWS
+        assert (
+            sort_mod._native_sort_min_rows()
+            == sort_mod._NATIVE_SORT_MIN_ROWS
+        )
+        assert (
+            sort_mod._native_partition_min_rows()
+            == sort_mod._NATIVE_PARTITION_MIN_ROWS
+        )
+        assert hash_mod._host_hash_max_rows() == hash_mod._HOST_HASH_MAX_ROWS
+        assert (
+            hash_mod._native_hash_min_rows()
+            == hash_mod._NATIVE_HASH_MIN_ROWS
+        )
+
+    def test_partition_threshold_calibrated(self, monkeypatch):
+        """The counting-scatter kernel has its own measured crossover —
+        it is not gated on the lexsort's (a different kernel with a
+        different overhead profile)."""
+        from hyperspace_tpu.native import calibrate
+        from hyperspace_tpu.ops import sort as sort_mod
+
+        monkeypatch.setattr(
+            calibrate,
+            "_probe",
+            lambda: calibrate.Thresholds(
+                native_partition_min_rows=1 << 17, source="calibrated"
+            ),
+        )
+        assert sort_mod._native_partition_min_rows() == 1 << 17
+
+    def test_probe_aborts_uncached_while_native_compiles(
+        self, tmp_path, monkeypatch
+    ):
+        """A query thread probing while the warm thread holds the native
+        build lock must get defaults immediately — no blocking behind
+        the one-time g++ run, and no caching of the degraded result."""
+        from hyperspace_tpu.native import calibrate
+
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_load_failed", False)
+        monkeypatch.setattr(native, "load", lambda wait=True: None)
+        got = calibrate.thresholds()
+        assert got.source == "defaults"
+        assert not os.path.exists(tmp_path / "_hs_calibration.json")
+        assert calibrate.thresholds().source == "defaults"
+
+    def test_module_attribute_override_beats_calibration(self, monkeypatch):
+        from hyperspace_tpu.native import calibrate
+        from hyperspace_tpu.ops import sort as sort_mod
+
+        monkeypatch.setattr(
+            calibrate,
+            "_probe",
+            lambda: calibrate.Thresholds(
+                native_sort_min_rows=4096, source="calibrated"
+            ),
+        )
+        monkeypatch.setattr(sort_mod, "_NATIVE_SORT_MIN_ROWS", 7)
+        assert sort_mod._native_sort_min_rows() == 7
+
+    def test_probe_failure_falls_back(self, monkeypatch):
+        from hyperspace_tpu.native import calibrate
+
+        monkeypatch.setattr(
+            calibrate,
+            "_probe",
+            lambda: (_ for _ in ()).throw(RuntimeError("boom")),
+        )
+        got = calibrate.thresholds()
+        assert got.source == "defaults"
 
 
 class TestDispatch:
