@@ -1,0 +1,71 @@
+"""hslint — repo-native static analysis for hyperspace_tpu.
+
+Five checkers guard the three correctness-critical seams nothing else
+checks mechanically (see ``docs/static-analysis.md``):
+
+* :mod:`kernel_parity` (HS1xx) — every native C++ export has a
+  registered numpy twin and a differential test;
+* :mod:`log_state` (HS2xx) — every Action's begin/commit edges are
+  legal transitions of the operation-log state machine;
+* :mod:`purity` (HS3xx) — no host numpy / host syncs inside traced
+  (jit/shard_map) hot-path functions;
+* :mod:`except_policy` (HS4xx) — no bare/overbroad excepts that can
+  mask the native rc-code or OCC contracts;
+* :mod:`locks` (HS5xx) — no lock-order cycles, no I/O under a lock.
+
+Run it: ``python -m hyperspace_tpu.analysis [package_dir]`` — exits
+nonzero when any unsuppressed finding remains. Suppress a finding with
+``# hslint: disable=<RULE>`` on (or directly above) the flagged line,
+with a justification comment.
+
+The analyzer is pure stdlib ``ast`` — importing this package never
+imports jax/numpy, and the checked code is never executed.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from hyperspace_tpu.analysis import (
+    except_policy,
+    kernel_parity,
+    locks,
+    log_state,
+    purity,
+)
+from hyperspace_tpu.analysis.core import FINDING_FIELDS, Finding, Project
+
+__all__ = [
+    "Finding",
+    "Project",
+    "ALL_RULES",
+    "CHECKERS",
+    "FINDING_FIELDS",
+    "run_analysis",
+]
+
+CHECKERS = (kernel_parity, log_state, purity, except_policy, locks)
+
+#: rule id -> one-line description; HS001 is the analyzer's own
+#: parse-failure rule.
+ALL_RULES: Dict[str, str] = {"HS001": "file does not parse"}
+for _mod in CHECKERS:
+    ALL_RULES.update(_mod.RULES)
+
+
+def run_analysis(
+    package_dir: str, tests_dir: Optional[str] = None
+) -> List[Finding]:
+    """All findings (suppressed ones included, marked) for the package at
+    ``package_dir``, sorted by (path, line, rule)."""
+    project = Project(package_dir, tests_dir=tests_dir)
+    findings: List[Finding] = list(project.findings)
+    for checker in CHECKERS:
+        findings.extend(checker.check(project))
+    by_display = {sf.rel_path: sf for sf in project.files.values()}
+    for f in findings:
+        sf = by_display.get(f.path)
+        if sf is not None and sf.is_suppressed(f.rule, f.line):
+            f.suppressed = True
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings

@@ -1,0 +1,96 @@
+"""CLI driver: ``python -m hyperspace_tpu.analysis [package_dir ...]``.
+
+Exit status: 0 when every finding is suppressed (or there are none),
+1 when unsuppressed findings remain, 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from hyperspace_tpu.analysis import ALL_RULES, run_analysis
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m hyperspace_tpu.analysis",
+        description="hslint: repo-native static analysis for hyperspace_tpu",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=None,
+        help="package directories to analyze (default: hyperspace_tpu "
+        "next to the installed package)",
+    )
+    parser.add_argument(
+        "--tests-dir",
+        default=None,
+        help="tests directory for the kernel-parity checker "
+        "(default: sibling tests/ of the package)",
+    )
+    parser.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format",
+    )
+    parser.add_argument(
+        "--show-suppressed",
+        action="store_true",
+        help="also print suppressed findings",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the ruleset and exit"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(ALL_RULES):
+            print(f"{rule}  {ALL_RULES[rule]}")
+        return 0
+
+    paths = args.paths or [
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ]
+    for p in paths:
+        if not os.path.isdir(p):
+            print(f"error: not a directory: {p}", file=sys.stderr)
+            return 2
+
+    all_findings = []
+    for p in paths:
+        all_findings.extend(run_analysis(p, tests_dir=args.tests_dir))
+
+    active = [f for f in all_findings if not f.suppressed]
+    suppressed = [f for f in all_findings if f.suppressed]
+    if args.format == "json":
+        shown = all_findings if args.show_suppressed else active
+        print(json.dumps([f.to_dict() for f in shown], indent=2))
+    else:
+        for f in active:
+            print(f.render())
+        if args.show_suppressed:
+            for f in suppressed:
+                print(f.render())
+        print(
+            f"hslint: {len(active)} finding(s), "
+            f"{len(suppressed)} suppressed, "
+            f"{len(ALL_RULES)} rules, {len(paths)} package(s)"
+        )
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # Downstream pager/head closed the pipe mid-print. The gate's
+        # verdict is unknown at this point, so exit with the conventional
+        # SIGPIPE status (128+13) — never 0, or `hslint.sh | head` under
+        # pipefail could wave a failing tree through.
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(141)

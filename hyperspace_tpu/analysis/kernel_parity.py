@@ -1,0 +1,183 @@
+"""HS1xx — native kernel / numpy-twin parity.
+
+The native C++ kernels (``native/hs_native.cpp``) are trusted only
+because each one has a numpy twin with bit-identical semantics and a
+differential test comparing the two (the Flare doctrine: a native fast
+path is only as good as its systematic parity check against the
+reference engine). This checker turns that contract into lint:
+
+* every ``extern "C"`` export must appear in the ``KERNEL_TWINS``
+  registry in ``native/__init__.py`` (HS101), and every registry entry
+  must name a real export (HS102);
+* the registered wrapper must be defined in ``native/__init__.py`` and
+  the registered numpy twin must resolve — either a ``numpy.*`` function
+  or a dotted path into the package whose target function exists
+  (HS103);
+* at least one file under ``tests/`` must reference the export or its
+  wrapper, so the parity claim is actually exercised (HS104).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from hyperspace_tpu.analysis.core import Finding, Project, const_str
+
+RULES = {
+    "HS101": "native export missing from the KERNEL_TWINS parity registry",
+    "HS102": "KERNEL_TWINS entry names a symbol not exported by hs_native.cpp",
+    "HS103": "KERNEL_TWINS wrapper or numpy twin does not resolve",
+    "HS104": "native kernel has no differential test referencing it",
+}
+
+# A C export: one or more type tokens, then an hs_-prefixed identifier,
+# then an argument list — anchored at line start so call sites inside
+# kernel bodies don't match.
+_EXPORT_RE = re.compile(
+    r"^(?:[A-Za-z_][A-Za-z0-9_]*\s+)+\**(hs_[A-Za-z0-9_]+)\s*\(", re.MULTILINE
+)
+
+
+def cpp_exports(cpp_text: str) -> List[str]:
+    """Exported symbol names: line-anchored ``hs_``-prefixed function
+    definitions. The ``hs_`` prefix is the export convention (internal
+    helpers are unprefixed/static), so no brace tracking of the
+    ``extern "C"`` block is needed — and brace counting through comments
+    and string literals is exactly the kind of fragile parsing a linter
+    should avoid."""
+    out: List[str] = []
+    for m in _EXPORT_RE.finditer(cpp_text):
+        if m.group(1) not in out:
+            out.append(m.group(1))
+    return out
+
+
+def _registry(tree: ast.AST) -> Optional[Tuple[int, Dict[str, Tuple[str, str]]]]:
+    """(line, {export: (wrapper, twin)}) from the KERNEL_TWINS literal."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if "KERNEL_TWINS" not in targets or not isinstance(node.value, ast.Dict):
+            continue
+        entries: Dict[str, Tuple[str, str]] = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            key = const_str(k) if k is not None else None
+            if key is None:
+                continue
+            wrapper = twin = ""
+            if isinstance(v, (ast.Tuple, ast.List)) and len(v.elts) >= 2:
+                wrapper = const_str(v.elts[0]) or ""
+                twin = const_str(v.elts[1]) or ""
+            entries[key] = (wrapper, twin)
+        return node.lineno, entries
+    return None
+
+
+def _twin_resolves(project: Project, twin: str) -> bool:
+    if twin.startswith("numpy."):
+        return True  # external reference twin; parity proven by the tests
+    pkg = os.path.basename(project.package_dir)
+    if not twin.startswith(pkg + "."):
+        return False
+    parts = twin[len(pkg) + 1 :].split(".")
+    if len(parts) < 2:
+        return False
+    mod_rel, func = "/".join(parts[:-1]) + ".py", parts[-1]
+    sf = project.file(mod_rel)
+    if sf is None or sf.tree is None:
+        return False
+    return any(
+        isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and n.name == func
+        for n in ast.walk(sf.tree)
+    )
+
+
+def check(project: Project) -> List[Finding]:
+    cpp = project.native_cpp_path()
+    native_sf = project.file("native/__init__.py")
+    if cpp is None or native_sf is None or native_sf.tree is None:
+        return []  # no native layer in this tree: nothing to check
+    with open(cpp, "r", encoding="utf-8") as f:
+        exports = cpp_exports(f.read())
+    reg = _registry(native_sf.tree)
+    findings: List[Finding] = []
+    if reg is None:
+        findings.append(
+            Finding(
+                "HS101",
+                native_sf.rel_path,
+                1,
+                "no KERNEL_TWINS registry found; every native export needs a "
+                f"registered numpy twin (exports: {', '.join(exports)})",
+            )
+        )
+        return findings
+    reg_line, entries = reg
+    wrappers_defined = {
+        n.name
+        for n in ast.walk(native_sf.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    tests = project.test_files()
+    for export in exports:
+        if export not in entries:
+            findings.append(
+                Finding(
+                    "HS101",
+                    native_sf.rel_path,
+                    reg_line,
+                    f"native export {export!r} has no KERNEL_TWINS entry "
+                    "(wrapper + numpy twin)",
+                )
+            )
+            continue
+        wrapper, twin = entries[export]
+        if wrapper not in wrappers_defined:
+            findings.append(
+                Finding(
+                    "HS103",
+                    native_sf.rel_path,
+                    reg_line,
+                    f"{export}: registered wrapper {wrapper!r} is not defined "
+                    "in native/__init__.py",
+                )
+            )
+        if not twin or not _twin_resolves(project, twin):
+            findings.append(
+                Finding(
+                    "HS103",
+                    native_sf.rel_path,
+                    reg_line,
+                    f"{export}: numpy twin {twin!r} does not resolve "
+                    "(expected numpy.<fn> or a dotted in-package function)",
+                )
+            )
+        if tests and not any(
+            export in text or (wrapper and wrapper in text) for _, text in tests
+        ):
+            findings.append(
+                Finding(
+                    "HS104",
+                    native_sf.rel_path,
+                    reg_line,
+                    f"{export}: no test under tests/ references {export!r} or "
+                    f"its wrapper {wrapper!r} — the parity contract is "
+                    "unverified",
+                )
+            )
+    for name in entries:
+        if name not in exports:
+            findings.append(
+                Finding(
+                    "HS102",
+                    native_sf.rel_path,
+                    reg_line,
+                    f"KERNEL_TWINS entry {name!r} matches no extern \"C\" "
+                    "export in hs_native.cpp (stale registry?)",
+                )
+            )
+    return findings

@@ -1,0 +1,227 @@
+"""HS3xx — hot-path purity inside traced (jit / shard_map / vmap) code.
+
+A traced JAX function must stay on-device: a stray ``np.*`` array op
+silently falls back to host numpy on concrete tracer values (or raises
+a TracerArrayConversionError much later), and a host sync
+(``block_until_ready``, ``.item()``, ``np.asarray``, ``float()`` on a
+tracer, ``jax.device_get``) serializes the pipeline — exactly the class
+of perf bug that bit the serve path before the dispatch-policy rework.
+
+Scope: files under ``ops/``, ``execution/``, ``parallel/`` and
+``rules/``. A function is *traced* when it is
+
+* decorated with ``jax.jit`` / ``functools.partial(jax.jit, ...)`` /
+  ``jax.vmap``, or
+* passed by name to ``jax.jit(...)``, ``jax.vmap(...)`` or
+  ``shard_map(...)`` anywhere in the same file.
+
+Analysis covers the traced function's body including nested ``def``s
+and lambdas (their bodies trace too). It deliberately does NOT follow
+calls into helper functions: helpers like ``ops/hash.hash_words`` are
+dtype-generic by design (shared between the numpy and device twins),
+and flagging them would force a fork of every shared kernel.
+
+Allowlist: ``np.<scalar-type>`` constructors (``np.uint32(4)`` makes a
+host constant, which traces fine) and dtype/introspection helpers
+(``np.iinfo``, ``np.dtype``, ``np.pi`` …).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from hyperspace_tpu.analysis.core import Finding, Project, dotted_name
+
+RULES = {
+    "HS301": "numpy call inside a traced (jit/shard_map/vmap) function",
+    "HS302": "host synchronization inside a traced function",
+}
+
+HOT_DIRS = ("ops", "execution", "parallel", "rules")
+
+#: np.<attr> uses that are pure host constants / introspection — safe
+#: under trace.
+NP_ALLOWED = {
+    "uint8", "uint16", "uint32", "uint64",
+    "int8", "int16", "int32", "int64", "intp",
+    "float16", "float32", "float64", "bool_",
+    "dtype", "iinfo", "finfo", "issubdtype",
+    "pi", "e", "inf", "nan", "newaxis", "errstate",
+}
+
+#: method names whose call on a traced value forces a host sync
+SYNC_METHODS = {"block_until_ready", "item", "tolist"}
+
+#: np.<attr> calls that are host syncs rather than plain numpy ops
+NP_SYNC = {"asarray", "array", "save", "savez"}
+
+_TRACERS = ("jit", "vmap", "shard_map", "pmap")
+
+
+def _is_tracer_expr(node: ast.AST) -> bool:
+    """True for ``jax.jit``, ``jax.vmap``, ``shard_map``, and
+    ``(functools.)partial(jax.jit, ...)`` expressions."""
+    name = dotted_name(node)
+    if name:
+        leaf = name.split(".")[-1]
+        if leaf in _TRACERS:
+            return True
+    if isinstance(node, ast.Call):
+        fn = dotted_name(node.func)
+        if fn and fn.split(".")[-1] == "partial" and node.args:
+            return _is_tracer_expr(node.args[0])
+        return _is_tracer_expr(node.func)
+    return False
+
+
+def _traced_names(tree: ast.AST) -> Set[str]:
+    """Names of functions passed (by name) to a tracer call anywhere in
+    the file: ``x = jax.jit(f)``, ``shard_map(local, ...)`` …"""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_tracer_expr(node.func):
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Name):
+                    out.add(arg.id)
+    return out
+
+
+def _traced_functions(tree: ast.AST) -> List[ast.FunctionDef]:
+    by_call = _traced_names(tree)
+    traced = [
+        node
+        for node in ast.walk(tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and (
+            node.name in by_call
+            or any(_is_tracer_expr(d) for d in node.decorator_list)
+        )
+    ]
+    # drop functions nested inside another traced function — the parent's
+    # body walk already covers them (avoids duplicate findings)
+    nested = set()
+    for fn in traced:
+        for sub in ast.walk(fn):
+            if sub is not fn and isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                nested.add(id(sub))
+    return [fn for fn in traced if id(fn) not in nested]
+
+
+def _annotation_nodes(fn: ast.FunctionDef) -> Set[int]:
+    """ids of every node inside a type annotation anywhere under ``fn``
+    (parameter/return annotations of fn and nested defs, AnnAssign
+    targets): annotations never execute under trace, so ``np.ndarray``
+    there must not flag."""
+    roots: List[ast.AST] = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = node.args
+            for a in (
+                list(args.posonlyargs)
+                + list(args.args)
+                + list(args.kwonlyargs)
+                + [args.vararg, args.kwarg]
+            ):
+                if a is not None and a.annotation is not None:
+                    roots.append(a.annotation)
+            if node.returns is not None:
+                roots.append(node.returns)
+        elif isinstance(node, ast.AnnAssign):
+            roots.append(node.annotation)
+    return {id(n) for root in roots for n in ast.walk(root)}
+
+
+def _check_body(fn: ast.FunctionDef, sf_path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    skip = _annotation_nodes(fn)
+    # walk only the body: decorators and annotations are def-time (or
+    # no-op) constructs, never traced
+    for node in [
+        n
+        for stmt in fn.body
+        for n in ast.walk(stmt)
+        if id(n) not in skip
+    ]:
+        # np.<attr> access
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id in ("np", "numpy")
+        ):
+            if node.attr in NP_SYNC:
+                findings.append(
+                    Finding(
+                        "HS302",
+                        sf_path,
+                        node.lineno,
+                        f"np.{node.attr} in traced function "
+                        f"{fn.name!r} forces a host transfer/sync",
+                    )
+                )
+            elif node.attr not in NP_ALLOWED:
+                findings.append(
+                    Finding(
+                        "HS301",
+                        sf_path,
+                        node.lineno,
+                        f"np.{node.attr} in traced function {fn.name!r} — "
+                        "use jnp (host numpy silently degrades or fails on "
+                        "tracers)",
+                    )
+                )
+        if isinstance(node, ast.Call):
+            # .block_until_ready() / .item() / .tolist()
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in SYNC_METHODS
+            ):
+                findings.append(
+                    Finding(
+                        "HS302",
+                        sf_path,
+                        node.lineno,
+                        f".{node.func.attr}() in traced function "
+                        f"{fn.name!r} is a host sync",
+                    )
+                )
+            fname = dotted_name(node.func)
+            if fname == "jax.device_get":
+                findings.append(
+                    Finding(
+                        "HS302",
+                        sf_path,
+                        node.lineno,
+                        f"jax.device_get in traced function {fn.name!r} "
+                        "is a host sync",
+                    )
+                )
+            # float(x)/int(x)/bool(x) on a non-literal concretizes a tracer
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in ("float", "int", "bool")
+                and node.args
+                and not isinstance(node.args[0], ast.Constant)
+            ):
+                findings.append(
+                    Finding(
+                        "HS302",
+                        sf_path,
+                        node.lineno,
+                        f"{node.func.id}() on a traced value in "
+                        f"{fn.name!r} concretizes the tracer (host sync)",
+                    )
+                )
+    return findings
+
+
+def check(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for _rel, sf in project.files_under(*HOT_DIRS):
+        if sf.tree is None:
+            continue
+        for fn in _traced_functions(sf.tree):
+            findings.extend(_check_body(fn, sf.rel_path))
+    return findings

@@ -125,7 +125,8 @@ class MinMaxSketch(Sketch):
 
         try:
             t = parse_arrow_type(self.source_type)
-        except Exception:
+        except (ValueError, HyperspaceException):
+            # unparseable recorded type: probe with the raw literal
             return lit
         if not pa.types.is_temporal(t):
             return lit

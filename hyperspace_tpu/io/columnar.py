@@ -330,7 +330,8 @@ def remap_codes(target_dictionary: List[str], col: "Column") -> np.ndarray:
 def _numpy_dtype_for(t: pa.DataType):
     try:
         return t.to_pandas_dtype()
-    except Exception:
+    except (NotImplementedError, TypeError):
+        # pyarrow has no numpy analogue for this type (decimal, nested…)
         return np.int64
 
 

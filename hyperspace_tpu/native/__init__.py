@@ -41,6 +41,31 @@ _lock = threading.Lock()
 _lib = None
 _load_failed = False
 
+# Machine-checked parity registry (hslint HS1xx, hyperspace_tpu/analysis):
+# every extern "C" export in hs_native.cpp maps to (ctypes wrapper defined
+# in this module, numpy twin the differential tests compare against).
+# Adding a kernel without registering it here — or without a test in
+# tests/ referencing it — fails `python -m hyperspace_tpu.analysis`.
+KERNEL_TWINS = {
+    "hs_lexsort_u32": ("lexsort_u32", "numpy.lexsort"),
+    "hs_partition_by_bucket": (
+        "partition_by_bucket_i32",
+        "hyperspace_tpu.ops.sort.partition_by_bucket_numpy",
+    ),
+    "hs_merge_join_count_i64": (
+        "merge_join_count_i64",
+        "hyperspace_tpu.execution.join_exec.merge_join_indices",
+    ),
+    "hs_merge_join_emit_i64": (
+        "merge_join_emit_into",
+        "hyperspace_tpu.execution.join_exec.merge_join_indices",
+    ),
+    "hs_bucket_ids_i64": (
+        "bucket_ids_i64",
+        "hyperspace_tpu.ops.hash.bucket_ids_numpy",
+    ),
+}
+
 
 def _cache_dir() -> str:
     """Directory for the compiled .so: next to the source when writable
@@ -139,11 +164,19 @@ def _compile(path: str) -> bool:
             detail,
         )
         if not transient:
+            # temp + atomic rename (the docs/static-analysis.md pattern):
+            # _failed_marker_fresh in another process must never read a
+            # half-written marker or see its mtime before the content.
+            marker_tmp = f"{path}.failed.tmp.{os.getpid()}"
             try:
-                with open(path + ".failed", "w") as f:
+                with open(marker_tmp, "w") as f:
                     f.write(detail)
+                os.replace(marker_tmp, path + ".failed")
             except OSError:
-                pass
+                try:
+                    os.unlink(marker_tmp)
+                except OSError:
+                    pass
         return False
 
 
@@ -188,7 +221,10 @@ def load(wait: bool = True):
     global _lib, _load_failed
     if _lib is not None or _load_failed:
         return _lib
-    if not _lock.acquire(blocking=wait):
+    # Lock-held I/O is the point here: the one-time g++ compile and CDLL
+    # load are deliberately serialized so exactly one thread builds;
+    # everyone else either waits (wait=True) or falls back to numpy.
+    if not _lock.acquire(blocking=wait):  # hslint: disable=HS502
         return None
     try:
         if _lib is not None or _load_failed:

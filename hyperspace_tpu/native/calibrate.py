@@ -101,7 +101,9 @@ def _machine_key() -> dict:
         import jax
 
         platform = jax.default_backend()
-    except Exception:  # jax unusable: host-only machine
+    # any jax failure (missing install, no backend, plugin crash) must
+    # degrade to a host-only fingerprint, never break thresholds()
+    except Exception:  # hslint: disable=HS402
         platform = "none"
     return {
         "version": _PROBE_VERSION,
@@ -318,6 +320,18 @@ def _load_cache() -> Optional[Thresholds]:
 
 
 def _store_cache(t: Thresholds) -> None:
+    """Publish the calibration JSON with write-to-temp + atomic rename.
+
+    This is the concurrency pattern documented in
+    ``docs/static-analysis.md`` (HS502 worked example): two processes
+    calibrating concurrently must never let a reader interleave with a
+    partial write. The temp name is pid-qualified so concurrent writers
+    never clobber each other's temp, ``os.replace`` makes the publish
+    atomic (readers see the old file or the new file, never a torn one),
+    and the fsync before rename keeps a crash from publishing an empty
+    file on journaled filesystems. Losing the last-writer race is fine:
+    both writers hold equivalent measurements for this machine key.
+    """
     path = _cache_file()
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
@@ -339,6 +353,8 @@ def _store_cache(t: Thresholds) -> None:
                 f,
                 indent=2,
             )
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
     except OSError:
         try:
@@ -356,7 +372,10 @@ def thresholds() -> Thresholds:
         return _cached
     if _probing or not _enabled():
         return _DEFAULTS
-    with _probe_lock:
+    # Lock-held I/O by design: the JSON cache read/write and the probe
+    # itself are what the lock serializes (one probe per process); the
+    # lock-free _cached fast path above keeps queries off this lock.
+    with _probe_lock:  # hslint: disable=HS502
         if _cached is not None:  # another thread probed while we waited
             return _cached
         if _probing:
@@ -372,7 +391,9 @@ def thresholds() -> Thresholds:
                 # don't cache a degraded measurement — defaults now, a
                 # later call probes for real
                 return _DEFAULTS
-            except Exception as exc:  # never let a probe break a query path
+            # catch-all is the contract: a failed probe must cost only the
+            # fallback constants, never a query
+            except Exception as exc:  # hslint: disable=HS402
                 _log.warning(
                     "dispatch calibration failed; using defaults: %s", exc
                 )

@@ -47,7 +47,9 @@ if _cache_dir != "off":
     try:
         jax.config.update("jax_compilation_cache_dir", _cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
-    except Exception:  # older jax without the knobs: in-memory cache only
+    # older jax without the knobs (exception type varies by version):
+    # in-memory cache only
+    except Exception:  # hslint: disable=HS402
         pass
 
 

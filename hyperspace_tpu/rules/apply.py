@@ -65,6 +65,8 @@ def apply_hyperspace(session, plan: LogicalPlan) -> LogicalPlan:
                     )
                 )
         return new_plan
-    except Exception:  # fall back to the original plan (:60-64)
+    # catch-all is the contract (reference ApplyHyperspace :60-64): a
+    # rewrite failure must degrade to the original plan, never the query
+    except Exception:  # hslint: disable=HS402
         logger.exception("Hyperspace plan rewrite failed; using original plan")
         return plan

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# hslint — repo-native static analysis gate.
+#
+# Runs the analyzer over the package; exits nonzero when any unsuppressed
+# finding remains (the same check tier-1 enforces via
+# tests/test_hslint.py::TestPackageClean). Extra arguments are passed
+# through, e.g.:
+#
+#   scripts/hslint.sh                      # the gate
+#   scripts/hslint.sh --show-suppressed    # also list justified suppressions
+#   scripts/hslint.sh --format json        # machine-readable findings
+#   scripts/hslint.sh --list-rules         # the ruleset
+#
+# Rule docs: docs/static-analysis.md
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m hyperspace_tpu.analysis hyperspace_tpu/ "$@"

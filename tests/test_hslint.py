@@ -1,0 +1,706 @@
+"""hslint (hyperspace_tpu/analysis) — tier-1 gate + checker self-tests.
+
+Three layers:
+
+* the GATE: the analyzer over the real package must report zero
+  unsuppressed findings (every rule violation on the tree is either
+  fixed or carries a justified ``# hslint: disable``);
+* fixture-based unit tests per checker: a seeded violation is caught,
+  a suppression comment silences it, and a clean tree stays clean;
+* golden stability: the ruleset and the finding schema are part of the
+  repo's contract (CI configs and suppression comments reference rule
+  ids), so changing them must be a deliberate act.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import hyperspace_tpu
+from hyperspace_tpu.analysis import (
+    ALL_RULES,
+    CHECKERS,
+    FINDING_FIELDS,
+    Finding,
+    run_analysis,
+)
+
+PKG_DIR = os.path.dirname(os.path.abspath(hyperspace_tpu.__file__))
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _write_tree(root, files):
+    for rel, text in files.items():
+        path = root / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(text))
+
+
+def _lint(tmp_path, files, tests=None):
+    """Unsuppressed findings for a fixture package tree."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(exist_ok=True)
+    _write_tree(pkg, files)
+    tests_dir = None
+    if tests is not None:
+        tdir = tmp_path / "tests"
+        tdir.mkdir(exist_ok=True)
+        _write_tree(tdir, tests)
+        tests_dir = str(tdir)
+    findings = run_analysis(str(pkg), tests_dir=tests_dir)
+    return [f for f in findings if not f.suppressed]
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# The gate
+# ---------------------------------------------------------------------------
+
+
+class TestPackageClean:
+    def test_no_unsuppressed_findings(self):
+        findings = run_analysis(PKG_DIR, tests_dir=TESTS_DIR)
+        active = [f for f in findings if not f.suppressed]
+        assert not active, "unsuppressed hslint findings:\n" + "\n".join(
+            f.render() for f in active
+        )
+
+    def test_analyzer_covers_real_surfaces(self):
+        """The gate is only meaningful if the checkers engage: the real
+        tree must contain native exports, actions, and traced functions
+        for them to look at (guards against a silent no-op analyzer)."""
+        from hyperspace_tpu.analysis.core import Project
+        from hyperspace_tpu.analysis import kernel_parity, log_state, purity
+
+        project = Project(PKG_DIR, tests_dir=TESTS_DIR)
+        with open(project.native_cpp_path()) as f:
+            exports = kernel_parity.cpp_exports(f.read())
+        assert len(exports) >= 5
+        machine, _ = log_state._extract_machine(project)
+        assert machine.rollback and machine.stable
+        traced = [
+            fn.name
+            for _rel, sf in project.files_under(*purity.HOT_DIRS)
+            if sf.tree is not None
+            for fn in purity._traced_functions(sf.tree)
+        ]
+        assert len(traced) >= 5
+
+
+# ---------------------------------------------------------------------------
+# Checker 1: kernel parity (HS1xx)
+# ---------------------------------------------------------------------------
+
+
+CPP = '''
+    extern "C" {
+    int hs_foo(const int* a, long long n) {
+      return 0;
+    }
+    }  // extern "C"
+'''
+
+NATIVE_OK = '''
+    KERNEL_TWINS = {
+        "hs_foo": ("foo", "numpy.lexsort"),
+    }
+
+    def foo():
+        return None
+'''
+
+
+class TestKernelParity:
+    def test_missing_registry_entry(self, tmp_path):
+        files = {
+            "native/hs_native.cpp": CPP,
+            "native/__init__.py": "KERNEL_TWINS = {}\n",
+        }
+        assert "HS101" in _rules(_lint(tmp_path, files))
+
+    def test_no_registry_at_all(self, tmp_path):
+        files = {
+            "native/hs_native.cpp": CPP,
+            "native/__init__.py": "def foo():\n    return None\n",
+        }
+        assert "HS101" in _rules(_lint(tmp_path, files))
+
+    def test_stale_entry_and_unresolved_twin(self, tmp_path):
+        files = {
+            "native/hs_native.cpp": CPP,
+            "native/__init__.py": (
+                "KERNEL_TWINS = {\n"
+                '    "hs_foo": ("missing_wrapper", "pkg.nowhere.fn"),\n'
+                '    "hs_gone": ("foo", "numpy.lexsort"),\n'
+                "}\n"
+                "def foo():\n    return None\n"
+            ),
+        }
+        rules = _rules(_lint(tmp_path, files))
+        assert "HS102" in rules and "HS103" in rules
+
+    def test_missing_differential_test(self, tmp_path):
+        files = {"native/hs_native.cpp": CPP, "native/__init__.py": NATIVE_OK}
+        findings = _lint(
+            tmp_path, files, tests={"test_other.py": "def test_x():\n    pass\n"}
+        )
+        assert "HS104" in _rules(findings)
+
+    def test_clean(self, tmp_path):
+        files = {"native/hs_native.cpp": CPP, "native/__init__.py": NATIVE_OK}
+        findings = _lint(
+            tmp_path,
+            files,
+            tests={"test_foo.py": "def test_foo():\n    assert foo\n"},
+        )
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# Checker 2: log state machine (HS2xx)
+# ---------------------------------------------------------------------------
+
+
+CONSTANTS = '''
+    class States:
+        DOESNOTEXIST = "DOESNOTEXIST"
+        CREATING = "CREATING"
+        ACTIVE = "ACTIVE"
+        DELETING = "DELETING"
+        DELETED = "DELETED"
+
+        STABLE_STATES = frozenset({ACTIVE, DELETED, DOESNOTEXIST})
+
+        ROLLBACK = {
+            CREATING: DOESNOTEXIST,
+            DELETING: ACTIVE,
+        }
+'''
+
+ACTIONS_CLEAN = '''
+    from pkg.constants import States
+
+    class CreateAction:
+        transient_state = States.CREATING
+        final_state = States.ACTIVE
+
+    class DeleteAction:
+        transient_state = States.DELETING
+        final_state = States.DELETED
+        required_state = States.ACTIVE
+'''
+
+
+class TestLogStateMachine:
+    def test_clean(self, tmp_path):
+        files = {"constants.py": CONSTANTS, "actions/act.py": ACTIONS_CLEAN}
+        assert _lint(tmp_path, files) == []
+
+    def test_illegal_transient_without_rollback(self, tmp_path):
+        # seeded illegal transition: ACTIVE used as a transient state —
+        # there is no rollback edge, cancel() could never recover it
+        files = {
+            "constants.py": CONSTANTS,
+            "actions/act.py": ACTIONS_CLEAN,
+            "actions/bad.py": """
+                from pkg.constants import States
+
+                class BadAction:
+                    transient_state = States.ACTIVE
+                    final_state = States.ACTIVE
+            """,
+        }
+        assert "HS201" in _rules(_lint(tmp_path, files))
+
+    def test_commit_to_unstable_state(self, tmp_path):
+        files = {
+            "constants.py": CONSTANTS,
+            "actions/act.py": ACTIONS_CLEAN,
+            "actions/bad.py": """
+                from pkg.constants import States
+
+                class BadAction:
+                    transient_state = States.CREATING
+                    final_state = States.DELETING
+            """,
+        }
+        assert "HS202" in _rules(_lint(tmp_path, files))
+
+    def test_unknown_state_name(self, tmp_path):
+        files = {
+            "constants.py": CONSTANTS,
+            "actions/act.py": ACTIONS_CLEAN
+            + "\n    BOGUS = States.FROBNICATING\n",
+        }
+        assert "HS203" in _rules(_lint(tmp_path, files))
+
+    def test_required_state_mismatch(self, tmp_path):
+        files = {
+            "constants.py": CONSTANTS,
+            "actions/act.py": ACTIONS_CLEAN,
+            "actions/bad.py": """
+                from pkg.constants import States
+
+                class BadAction:
+                    transient_state = States.CREATING
+                    final_state = States.ACTIVE
+                    required_state = States.ACTIVE
+            """,
+        }
+        assert "HS204" in _rules(_lint(tmp_path, files))
+
+    def test_unused_rollback_state(self, tmp_path):
+        files = {
+            "constants.py": CONSTANTS,
+            "actions/act.py": """
+                from pkg.constants import States
+
+                class CreateAction:
+                    transient_state = States.CREATING
+                    final_state = States.ACTIVE
+            """,
+        }
+        assert "HS205" in _rules(_lint(tmp_path, files))
+
+    def test_suppression(self, tmp_path):
+        files = {
+            "constants.py": CONSTANTS,
+            "actions/act.py": ACTIONS_CLEAN,
+            "actions/bad.py": """
+                from pkg.constants import States
+
+                class BadAction:
+                    transient_state = States.ACTIVE  # hslint: disable=HS201
+                    final_state = States.ACTIVE
+            """,
+        }
+        assert _lint(tmp_path, files) == []
+
+
+# ---------------------------------------------------------------------------
+# Checker 3: hot-path purity (HS3xx)
+# ---------------------------------------------------------------------------
+
+
+class TestPurity:
+    def test_numpy_in_jit(self, tmp_path):
+        files = {
+            "ops/k.py": """
+                import jax
+                import jax.numpy as jnp
+                import numpy as np
+
+                @jax.jit
+                def bad(x):
+                    return np.concatenate([x, x])
+            """
+        }
+        assert "HS301" in _rules(_lint(tmp_path, files))
+
+    def test_host_sync_in_jit(self, tmp_path):
+        files = {
+            "ops/k.py": """
+                import jax
+
+                @jax.jit
+                def bad(x):
+                    return x.item()
+            """
+        }
+        assert "HS302" in _rules(_lint(tmp_path, files))
+
+    def test_shard_map_by_name_and_partial_jit(self, tmp_path):
+        files = {
+            "parallel/k.py": """
+                import functools
+                import jax
+                import numpy as np
+                from jax.experimental.shard_map import shard_map
+
+                def local(x):
+                    return np.argsort(x)
+
+                def run(mesh, x):
+                    return shard_map(local, mesh=mesh)(x)
+
+                @functools.partial(jax.jit, static_argnames=("n",))
+                def also_bad(x, n):
+                    return np.asarray(x)
+            """
+        }
+        findings = _lint(tmp_path, files)
+        assert "HS301" in _rules(findings)  # np.argsort in shard_map'd fn
+        assert "HS302" in _rules(findings)  # np.asarray under jit
+
+    def test_clean_and_allowlist(self, tmp_path):
+        files = {
+            "ops/k.py": """
+                import jax
+                import jax.numpy as jnp
+                import numpy as np
+
+                @jax.jit
+                def good(x):
+                    return jnp.sum(x) + np.uint32(1)
+
+                def host_helper(x):
+                    # not traced: host numpy is fine here
+                    return np.asarray(x).item()
+            """
+        }
+        assert _lint(tmp_path, files) == []
+
+    def test_suppression(self, tmp_path):
+        files = {
+            "ops/k.py": """
+                import jax
+                import numpy as np
+
+                @jax.jit
+                def bad(x):
+                    # callback runs host-side by contract here
+                    return np.log(x)  # hslint: disable=HS301
+            """
+        }
+        assert _lint(tmp_path, files) == []
+
+    def test_suppression_with_inline_justification(self, tmp_path):
+        # text after the rule id must not break the suppression match
+        files = {
+            "ops/k.py": """
+                import jax
+                import numpy as np
+
+                @jax.jit
+                def bad(x):
+                    return np.log(x)  # hslint: disable=HS301 host cb contract
+            """
+        }
+        assert _lint(tmp_path, files) == []
+
+    def test_annotations_are_not_traced(self, tmp_path):
+        # np.ndarray annotations evaluate at def time, never under trace
+        files = {
+            "ops/k.py": """
+                import jax
+                import jax.numpy as jnp
+                import numpy as np
+
+                @jax.jit
+                def good(x: np.ndarray) -> np.ndarray:
+                    y: np.ndarray = jnp.sum(x)
+                    return y
+            """
+        }
+        assert _lint(tmp_path, files) == []
+
+
+# ---------------------------------------------------------------------------
+# Checker 4: exception policy (HS4xx)
+# ---------------------------------------------------------------------------
+
+
+class TestExceptPolicy:
+    def test_bare_except(self, tmp_path):
+        files = {
+            "m.py": """
+                def f():
+                    try:
+                        return 1
+                    except:
+                        return None
+            """
+        }
+        assert "HS401" in _rules(_lint(tmp_path, files))
+
+    def test_broad_except_without_reraise(self, tmp_path):
+        files = {
+            "m.py": """
+                def f():
+                    try:
+                        return 1
+                    except Exception:
+                        return None
+            """
+        }
+        assert "HS402" in _rules(_lint(tmp_path, files))
+
+    def test_reraise_is_allowed(self, tmp_path):
+        files = {
+            "m.py": """
+                def f():
+                    try:
+                        return 1
+                    except Exception as e:
+                        print(e)
+                        raise
+            """
+        }
+        assert _lint(tmp_path, files) == []
+
+    def test_typed_is_clean_and_suppression_works(self, tmp_path):
+        files = {
+            "m.py": """
+                def f():
+                    try:
+                        return 1
+                    except ValueError:
+                        return None
+
+                def g():
+                    try:
+                        return 1
+                    # deliberate catch-all: fallback is the contract
+                    except Exception:  # hslint: disable=HS402
+                        return None
+            """
+        }
+        assert _lint(tmp_path, files) == []
+
+
+# ---------------------------------------------------------------------------
+# Checker 5: locks (HS5xx)
+# ---------------------------------------------------------------------------
+
+
+class TestLocks:
+    def test_seeded_lock_order_cycle(self, tmp_path):
+        files = {
+            "a.py": """
+                import threading
+
+                A = threading.Lock()
+                B = threading.Lock()
+
+                def f():
+                    with A:
+                        with B:
+                            pass
+
+                def g():
+                    with B:
+                        with A:
+                            pass
+            """
+        }
+        assert "HS501" in _rules(_lint(tmp_path, files))
+
+    def test_cross_function_cycle(self, tmp_path):
+        # f holds A and calls helper() which takes B; g does the reverse
+        # through its own callee — only the transitive call graph sees it
+        files = {
+            "a.py": """
+                import threading
+
+                A = threading.Lock()
+                B = threading.Lock()
+
+                def take_b():
+                    with B:
+                        pass
+
+                def take_a():
+                    with A:
+                        pass
+
+                def f():
+                    with A:
+                        take_b()
+
+                def g():
+                    with B:
+                        take_a()
+            """
+        }
+        assert "HS501" in _rules(_lint(tmp_path, files))
+
+    def test_lock_held_io_direct_and_via_callee(self, tmp_path):
+        files = {
+            "a.py": """
+                import threading
+
+                A = threading.Lock()
+
+                def io_helper(p):
+                    with open(p) as f:
+                        return f.read()
+
+                def direct(p):
+                    with A:
+                        return open(p).read()
+
+                def via_callee(p):
+                    with A:
+                        return io_helper(p)
+            """
+        }
+        findings = [f for f in _lint(tmp_path, files) if f.rule == "HS502"]
+        assert len(findings) == 2
+
+    def test_consistent_order_is_clean(self, tmp_path):
+        files = {
+            "a.py": """
+                import threading
+
+                A = threading.Lock()
+                B = threading.Lock()
+
+                def f():
+                    with A:
+                        with B:
+                            pass
+
+                def g():
+                    with A:
+                        with B:
+                            pass
+            """
+        }
+        assert _lint(tmp_path, files) == []
+
+    def test_same_class_name_in_two_modules_does_not_alias(self, tmp_path):
+        # instance locks are keyed by (module, class): two classes both
+        # named Cache must be distinct lock identities, or their edges
+        # would merge and could fake a cycle across unrelated modules
+        from hyperspace_tpu.analysis.core import Project
+        from hyperspace_tpu.analysis.locks import _collect_defs
+
+        src = """
+            import threading
+
+            class Cache:
+                def __init__(self):
+                    self._lock = threading.Lock()
+        """
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        _write_tree(pkg, {"a.py": src, "b.py": src})
+        _indexes, locks = _collect_defs(Project(str(pkg)))
+        assert len(locks) == 2
+        assert {scope for scope, _ in locks} == {
+            "cls:a.py:Cache",
+            "cls:b.py:Cache",
+        }
+
+    def test_instance_locks_and_suppression(self, tmp_path):
+        files = {
+            "a.py": """
+                import threading
+
+                class Cache:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def load(self, p):
+                        # one-time load is serialized by design
+                        with self._lock:  # hslint: disable=HS502
+                            return open(p).read()
+
+                    def get(self, k):
+                        with self._lock:
+                            return k
+            """
+        }
+        assert _lint(tmp_path, files) == []
+
+
+# ---------------------------------------------------------------------------
+# Golden: ruleset + finding schema stability
+# ---------------------------------------------------------------------------
+
+
+class TestGolden:
+    EXPECTED_RULES = [
+        "HS001",
+        "HS101",
+        "HS102",
+        "HS103",
+        "HS104",
+        "HS201",
+        "HS202",
+        "HS203",
+        "HS204",
+        "HS205",
+        "HS301",
+        "HS302",
+        "HS401",
+        "HS402",
+        "HS501",
+        "HS502",
+    ]
+
+    def test_ruleset_is_stable(self):
+        assert sorted(ALL_RULES) == self.EXPECTED_RULES
+        for rule, desc in ALL_RULES.items():
+            assert desc and isinstance(desc, str)
+
+    def test_every_checker_owns_rules(self):
+        owned = [r for mod in CHECKERS for r in mod.RULES]
+        assert sorted(owned) == self.EXPECTED_RULES[1:]  # HS001 is core's
+        assert len(owned) == len(set(owned))
+
+    def test_finding_schema_is_stable(self):
+        assert FINDING_FIELDS == ("rule", "path", "line", "message", "suppressed")
+        f = Finding("HS999", "pkg/x.py", 3, "msg")
+        assert f.to_dict() == {
+            "rule": "HS999",
+            "path": "pkg/x.py",
+            "line": 3,
+            "message": "msg",
+            "suppressed": False,
+        }
+        assert f.render() == "pkg/x.py:3: HS999 msg"
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+# ---------------------------------------------------------------------------
+
+
+class TestCli:
+    def _run(self, *args):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        return subprocess.run(
+            [sys.executable, "-m", "hyperspace_tpu.analysis", *args],
+            capture_output=True,
+            text=True,
+            env=env,
+            cwd=os.path.dirname(PKG_DIR),
+            timeout=120,
+        )
+
+    def test_exit_nonzero_on_violation(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        _write_tree(
+            pkg,
+            {
+                "m.py": """
+                    def f():
+                        try:
+                            return 1
+                        except:
+                            return None
+                """
+            },
+        )
+        proc = self._run(str(pkg))
+        assert proc.returncode == 1
+        assert "HS401" in proc.stdout
+
+    def test_exit_zero_on_clean_tree(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        _write_tree(pkg, {"m.py": "def f():\n    return 1\n"})
+        proc = self._run(str(pkg))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_list_rules(self, tmp_path):
+        proc = self._run("--list-rules")
+        assert proc.returncode == 0
+        for rule in TestGolden.EXPECTED_RULES:
+            assert rule in proc.stdout

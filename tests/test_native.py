@@ -129,6 +129,18 @@ class TestMergeJoinParity:
         self._check([1, 2], [])
         self._check([], [1, 2])
 
+    def test_count_matches_reference(self):
+        # hs_merge_join_count_i64 parity: the count pass must agree with
+        # the searchsorted reference (and hence with the emit pass, whose
+        # buffers are sized from it).
+        rng = np.random.default_rng(19)
+        for n, m in [(0, 7), (7, 0), (64, 64), (1000, 300)]:
+            ls = np.sort(rng.integers(0, 50, n).astype(np.int64))
+            rs = np.sort(rng.integers(0, 50, m).astype(np.int64))
+            got = native.merge_join_count_i64(ls, rs)
+            assert got is not None
+            assert got == len(_merge_ref(ls, rs)[0])
+
     def test_no_overlap(self):
         self._check([1, 2, 3], [4, 5, 6])
         self._check([4, 5, 6], [1, 2, 3])
