@@ -200,3 +200,13 @@ SERVE_CACHE_ENABLED = "hyperspace.serve.cache.enabled"
 SERVE_CACHE_ENABLED_DEFAULT = False
 SERVE_CACHE_MAX_BYTES = "hyperspace.serve.cache.maxBytes"
 SERVE_CACHE_MAX_BYTES_DEFAULT = 4 << 30  # 4 GiB
+
+# Pipelined serve path (execution/executor.py + join_exec.py, see
+# docs/serve-pipeline.md): on a co-bucketed join over clean index-scan
+# shapes, the two sides prepare concurrently, per-bucket parquet reads
+# overlap per-bucket prepare (reps/combine/sortedness), and the
+# hybrid-scan appended-files delta is prepared off the critical path.
+# Results are bit-identical to the sequential path (differential-tested);
+# the flag exists for A/B timing and as an escape hatch.
+SERVE_PIPELINE_ENABLED = "hyperspace.serve.pipeline.enabled"
+SERVE_PIPELINE_ENABLED_DEFAULT = True

@@ -176,6 +176,14 @@ def _serve_cache(session):
     return session.serve_cache
 
 
+def _serve_pipeline_on(session) -> bool:
+    """Pipelined serve path enabled (``hyperspace.serve.pipeline.enabled``,
+    default on). Sessionless callers run the sequential path — the
+    pipeline's thread fan-out is a serve-process feature, not a library
+    default for one-shot embedding."""
+    return session is not None and session.conf.serve_pipeline_enabled
+
+
 def _cacheable_scan(rel) -> bool:
     """Only clean INDEX scans are cached (index data files are immutable
     and bounded; pinning arbitrary source tables in RAM is not this
@@ -396,12 +404,57 @@ def _exec_join(plan: Join, needed: Set[str], session) -> ColumnarBatch:
         # Prepared sides (concat + key reps + sortedness) are retained by
         # the serve cache, so a warm serve pays only match + assemble.
         num_buckets, l_bucket_cols, r_bucket_cols = layout
-        lp = _prepared_join_side(
-            plan.left, l_needed, session, l_bucket_cols, [l for l, _ in on]
+        from hyperspace_tpu.execution.join_exec import serve_breakdown_reset
+
+        serve_breakdown_reset()
+        l_keys = [l for l, _ in on]
+        r_keys = [r for _, r in on]
+        # Pipelined serve: both sides prepare CONCURRENTLY (each side's
+        # per-bucket reads already overlap its prepare via the scan
+        # pool). Gated on both children being clean index-scan shapes —
+        # exactly the shapes whose execution touches no device kernels
+        # and no query-shaped state, so the thread fan-out is safe. A
+        # SELF-join whose sides would resolve to the same serve-cache
+        # entry stays sequential: racing both sides past the shared miss
+        # would double the full read+prepare the second side gets for
+        # free from the first side's put.
+        rels_l = _joinside_cache_relations(plan.left)
+        rels_r = _joinside_cache_relations(plan.right)
+        same_cached_side = (
+            _serve_cache(session) is not None
+            and rels_l is not None
+            and rels_l == rels_r
+            and l_needed == r_needed
+            and l_keys == r_keys
         )
-        rp = _prepared_join_side(
-            plan.right, r_needed, session, r_bucket_cols, [r for _, r in on]
-        )
+        if (
+            _serve_pipeline_on(session)
+            and rels_l is not None
+            and rels_r is not None
+            and not same_cached_side
+        ):
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="hs-joinside"
+            ) as side_pool:
+                fl = side_pool.submit(
+                    _prepared_join_side,
+                    plan.left, l_needed, session, l_bucket_cols, l_keys,
+                )
+                fr = side_pool.submit(
+                    _prepared_join_side,
+                    plan.right, r_needed, session, r_bucket_cols, r_keys,
+                )
+                lp = fl.result()
+                rp = fr.result()
+        else:
+            lp = _prepared_join_side(
+                plan.left, l_needed, session, l_bucket_cols, l_keys
+            )
+            rp = _prepared_join_side(
+                plan.right, r_needed, session, r_bucket_cols, r_keys
+            )
         mesh = session.runtime.mesh if session is not None else None
         min_rows = (
             session.conf.device_join_min_rows if session is not None else 0
@@ -468,8 +521,20 @@ def _prepared_join_side(
     """A PreparedJoinSide for one co-bucketed join child, served from the
     serve cache when the child is a clean Project*(Scan) chain (the plan
     shape of an index-only scan) or a Hybrid-Scan append union of two
-    such chains. Returns None for an empty side."""
-    from hyperspace_tpu.execution.join_exec import prepare_join_side
+    such chains. Returns None for an empty side.
+
+    On a cache miss (or with the cache off) the pipelined serve path
+    streams per-bucket batches straight into
+    ``prepare_join_side_pipelined``: bucket *i*'s reps/combine run while
+    the scan pool is still reading bucket *i+1*, and — on the hybrid
+    Union shape — the appended-files delta prepares concurrently with
+    the index-side reads. Falls back to the sequential
+    ``_exec_bucketed`` + ``prepare_join_side`` whenever the shape or
+    caching situation is anything but the clean serve case."""
+    from hyperspace_tpu.execution.join_exec import (
+        prepare_join_side,
+        prepare_join_side_pipelined,
+    )
 
     cache = _serve_cache(session)
     key = None
@@ -489,6 +554,18 @@ def _prepared_join_side(
                 hit = cache.get(key)
                 if hit is not None:
                     return hit
+    # Pipelined path only when it cannot change caching behavior: with
+    # the cache off nothing is cached either way; with a joinside key the
+    # raw bucketed batches are deliberately NOT cached (see below). The
+    # odd corner — cache on but the file set unfingerprintable — keeps
+    # the sequential path and its ("bucketed", …) entries.
+    if _serve_pipeline_on(session) and (cache is None or key is not None):
+        stream = _bucket_stream(plan, needed, session, bucket_cols)
+        if stream is not None:
+            prep = prepare_join_side_pipelined(stream, key_cols)
+            if prep is not None and key is not None:
+                cache.put(key, prep, prep.nbytes)
+            return prep
     # when a joinside entry will be cached, don't ALSO cache the raw
     # bucketed batches — the prepared side contains the same decoded data
     # (a second full copy would halve effective cache capacity)
@@ -847,6 +924,214 @@ def _exec_bucketed(
     raise HyperspaceException(
         f"Node not supported in bucketed execution: {type(plan).__name__}"
     )
+
+
+def _bucket_stream(plan: LogicalPlan, needed: Set[str], session, bucket_cols):
+    """Ordered ``[(bucket, fetch)]`` pairs for a clean linear subtree —
+    the pipelined twin of :func:`_exec_bucketed` (docs/serve-pipeline.md).
+
+    Per-bucket parquet reads are submitted to the shared scan pool
+    (``io/scan.scan_pool``) up front; each ``fetch()`` blocks until its
+    bucket's decoded batch is ready, so the consumer
+    (``prepare_join_side_pipelined``) overlaps bucket *i*'s prepare with
+    the reads of buckets *i+1…*. On the hybrid Union shape the
+    appended-files delta prepare runs on the pool concurrently with the
+    index-side reads (``_prepare_delta``). Batches are produced by the
+    same select/concat calls as the sequential path, per bucket — the
+    two paths are differential-tested bit-identical. Returns None when
+    the shape/format does not support streaming (caller falls back)."""
+    import time as _t
+
+    from hyperspace_tpu.execution import join_exec as _je
+    from hyperspace_tpu.io.parquet import bucket_id_of_file
+    from hyperspace_tpu.io.scan import scan_pool
+
+    if isinstance(plan, Scan):
+        rel = plan.relation
+        groups: dict = {}
+        for f in rel.files:
+            b = bucket_id_of_file(f)
+            groups.setdefault(b, []).append(f)
+        streamable = (
+            rel.fmt in ("parquet", "delta", "iceberg")
+            and rel.excluded_file_ids is None
+            and not rel.file_partition_values
+            and len(rel.files) > 1
+            and None not in groups
+        )
+        if not streamable:
+            return None
+        cols = [c for c in rel.column_names if c in needed] or (
+            rel.column_names[:1]
+        )
+        fmt = rel.fmt
+
+        def read_bucket(files):
+            # pure Arrow read in the worker — the C++ readers run on
+            # Arrow's own pool and release the GIL, so N in-flight reads
+            # genuinely overlap; the (GIL-bound) SoA decode happens on
+            # the consumer thread as the first step of that bucket's
+            # prepare, not here where it would serialize the workers
+            t0 = _t.perf_counter()
+            table = pio.read_table(files, cols, fmt)
+            _je._stage_add("scan", t0)
+            return table
+
+        def decode(fut):
+            def run():
+                table = fut.result()
+                t0 = _t.perf_counter()
+                batch = ColumnarBatch.from_arrow(table)
+                _je._stage_add("prepare", t0)
+                return batch
+
+            return run
+
+        pool = scan_pool()
+        return [
+            (b, decode(pool.submit(read_bucket, list(groups[b]))))
+            for b in sorted(groups)
+        ]
+    if isinstance(plan, Project):
+        cols = [c for c in plan.columns if c in needed] or plan.columns
+        child = _bucket_stream(plan.child, set(cols), session, bucket_cols)
+        if child is None:
+            return None
+
+        def project(fetch, cols=cols):
+            def run():
+                batch = fetch()
+                return batch.select(
+                    [c for c in cols if c in batch.column_names]
+                )
+
+            return run
+
+        return [(b, project(fetch)) for b, fetch in child]
+    if isinstance(plan, Union):
+        cols = [c for c in plan.output if c in needed] or plan.output[:1]
+        read_cols = sorted(set(cols) | set(bucket_cols))
+        spec = _bucket_layout(plan.left)
+        if spec is None:
+            return None
+        # the delta prepare is submitted FIRST so it takes a pool worker
+        # immediately and runs concurrently with the index-side bucket
+        # reads queued right after — off the serve critical path; with
+        # the serve cache on, repeat queries skip it entirely
+        # (fingerprint-keyed ("delta", …) entry)
+        delta_fut = scan_pool().submit(
+            _prepare_delta, plan.right, read_cols, session, bucket_cols,
+            spec[0],
+        )
+        left = _bucket_stream(
+            plan.left, set(read_cols), session, bucket_cols
+        )
+        if left is None:
+            # rare fallback (e.g. single-file index side): surface any
+            # delta read error here — the sequential path would hit the
+            # same files — and let its cache entry warm the retry
+            delta_fut.result()
+            return None
+
+        def select_left(fetch, read_cols=read_cols):
+            return lambda: fetch().select(read_cols)
+
+        left_map = {b: select_left(fetch) for b, fetch in left}
+
+        def merged(b):
+            def run():
+                parts = delta_fut.result()
+                part = parts.get(b)
+                if b not in left_map:
+                    return part
+                batch = left_map[b]()
+                if part is None:
+                    return batch
+                return ColumnarBatch.concat([batch, part])
+
+            return run
+
+        # Delta-only buckets (appended keys hashing into buckets the
+        # index side has no file for) interleave by bucket id, exactly
+        # like the sequential Union branch's dict after sorting. When the
+        # index side already covers EVERY bucket of the layout — the
+        # normal covering-index state — the delta cannot create new
+        # buckets, so the bucket list is known without blocking on the
+        # delta future and per-bucket prepare starts immediately (each
+        # merged fetch blocks on the delta only when its own bucket
+        # prepares). Only an index with empty buckets pays the upfront
+        # wait for the delta's bucket set.
+        if len(left_map) == spec[0]:
+            all_buckets = sorted(left_map)
+        else:
+            all_buckets = sorted(
+                set(left_map) | set(delta_fut.result().keys())
+            )
+        return [(b, merged(b)) for b in all_buckets]
+    return None
+
+
+def _prepare_delta(
+    plan: LogicalPlan, read_cols, session, bucket_cols, num_buckets: int
+):
+    """Per-bucket parts of the hybrid-scan appended-files delta: read the
+    appended source rows, hash them into the index's bucket layout, and
+    split — the execution-time equivalent of the reference's on-the-fly
+    shuffle of appended data, hoisted off the serve critical path.
+
+    With serve-server mode on, the result is cached keyed by the delta
+    FILE FINGERPRINT (+ columns, bucket columns, bucket count): appended
+    source files are immutable once written, a further append changes
+    the file set and therefore the key, so repeated hybrid queries pay
+    only the per-bucket merge."""
+    import time as _t
+
+    from hyperspace_tpu.execution import join_exec as _je
+
+    t0 = _t.perf_counter()
+    cache = _serve_cache(session)
+    key = None
+    if cache is not None:
+        node = plan
+        while isinstance(node, Project):
+            node = node.child
+        if (
+            isinstance(node, Scan)
+            and node.relation.excluded_file_ids is None
+            and not node.relation.file_partition_values
+            and node.relation.files
+        ):
+            from hyperspace_tpu.execution.serve_cache import file_fingerprint
+
+            fp = file_fingerprint(node.relation.files)
+            if fp is not None:
+                key = (
+                    "delta",
+                    fp,
+                    tuple(read_cols),
+                    tuple(bucket_cols),
+                    num_buckets,
+                )
+                hit = cache.get(key)
+                if hit is not None:
+                    return hit
+    appended = _exec(plan, set(read_cols), session).select(read_cols)
+    parts = {}
+    if appended.num_rows:
+        from hyperspace_tpu.ops.hash import bucket_ids_np
+
+        reps = appended.key_reps(list(bucket_cols))
+        bids = bucket_ids_np(reps, num_buckets)
+        for b in np.unique(bids):
+            parts[int(b)] = appended.filter(bids == b)
+    if key is not None:
+        from hyperspace_tpu.execution.serve_cache import batch_nbytes
+
+        cache.put(
+            key, dict(parts), sum(batch_nbytes(p) for p in parts.values())
+        )
+    _je._stage_add("delta", t0)
+    return parts
 
 
 def _filter_mask(

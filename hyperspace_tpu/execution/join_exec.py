@@ -18,12 +18,21 @@ combine, per-bucket sortedness — all query-independent) and *serve*
 (match + expand + verify + assemble). The prepared side is exactly what
 the serve cache (``execution/serve_cache.py``) retains between queries,
 so a warm serve pays only the per-query match work.
+
+Pipelined serve (round 7): on the uncached path the executor streams
+per-bucket batches through :func:`prepare_join_side_pipelined` while
+later buckets are still being read (``docs/serve-pipeline.md``), the
+per-bucket match/expand runs on a thread pool, and the stage timings
+accumulate in :data:`last_serve_breakdown` (same shape as the build's
+``last_build_breakdown``) so regressions are attributable to a stage.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Tuple
+import threading as _threading
+import time as _time
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
@@ -36,6 +45,67 @@ _SENTINEL_BASE = np.int64(-0x4000000000000000)
 # searchsorted overhead is already microseconds and a first native call
 # would pay the one-time g++ compile for nothing.
 _NATIVE_JOIN_MIN_ROWS = 1 << 14
+
+# At or above this combined row count the per-bucket match loop runs on
+# a thread pool (the native count/emit/sort calls release the GIL);
+# below it thread spawn overhead exceeds the whole match.
+_PAR_MATCH_MIN_ROWS = 1 << 20
+
+# Per-serve stage timing (seconds), reset by the executor at the start
+# of each co-bucketed join and read by bench.py — the serve analogue of
+# ``indexes/covering_build.last_build_breakdown``. Stages overlap under
+# the pipelined serve (scan of bucket i+1 runs while bucket i prepares;
+# per-bucket match fans out over threads), so stage values are BUSY time
+# and may sum past wall time; the overlapped excess is the pipeline win.
+# Diagnostic scope: PROCESS-GLOBAL and last-writer-wins, like the build
+# breakdown — meaningful for one join at a time (bench, diagnosis);
+# concurrent queries in a serve process interleave their timings here
+# (results are unaffected; only this attribution blurs).
+last_serve_breakdown: Dict[str, float] = {}
+_serve_bd_lock = _threading.Lock()
+
+
+def serve_breakdown_reset() -> None:
+    with _serve_bd_lock:
+        last_serve_breakdown.clear()
+
+
+def _stage_add(stage: str, t0: float) -> None:
+    dt = _time.perf_counter() - t0
+    with _serve_bd_lock:
+        last_serve_breakdown[stage] = (
+            last_serve_breakdown.get(stage, 0.0) + dt
+        )
+
+
+def _match_workers(n_buckets: int, total_rows: int) -> int:
+    """Thread count for the per-bucket match fan-out (1 = stay inline)."""
+    if total_rows < _PAR_MATCH_MIN_ROWS or n_buckets <= 1:
+        return 1
+    from hyperspace_tpu import native
+
+    return max(1, min(n_buckets, native._cores(), 8))
+
+
+def _stable_argsort_i64(a: np.ndarray, n_threads: Optional[int] = None):
+    """``np.argsort(a, kind="stable")`` for int64 keys, dispatching to the
+    native threaded radix lexsort above its calibrated crossover —
+    bit-identical output (signed int64 order == lexicographic order of
+    the sign-flipped hi / lo uint32 planes; both engines are stable).
+    Host-only by construction: never touches the device, so per-bucket
+    serve sorts can fan out across threads (the native call releases the
+    GIL; numpy's argsort does not)."""
+    from hyperspace_tpu.ops import sort as sort_mod
+
+    if len(a) >= sort_mod._native_sort_min_rows():
+        from hyperspace_tpu import native
+
+        perm = native.lexsort_u32(
+            sort_mod._order_words_np(a[None, :]), n_threads=n_threads
+        )
+        if perm is not None:
+            return perm
+    return np.argsort(a, kind="stable")
 
 
 def merge_join_indices(
@@ -50,7 +120,7 @@ def merge_join_indices(
     ``np.unique(axis=0)`` void-view grouping at millions of rows. For
     k > 1 the combine can collide, so pairs are superset-exact and the
     caller MUST re-verify key columns (``inner_join`` does)."""
-    from hyperspace_tpu.ops.join import combine_reps_np
+    from hyperspace_tpu.ops.join import combine_reps_np, expand_match_ranges
 
     n, m = l_reps.shape[1], r_reps.shape[1]
     if n == 0 or m == 0:
@@ -58,20 +128,14 @@ def merge_join_indices(
         return z, z
     l1 = combine_reps_np(l_reps)
     r1 = combine_reps_np(r_reps)
-    order_r = np.argsort(r1, kind="stable")
+    order_r = _stable_argsort_i64(r1)
     rs = r1[order_r]
     lo = np.searchsorted(rs, l1, side="left")
     hi = np.searchsorted(rs, l1, side="right")
-    cnt = hi - lo
-    total = int(cnt.sum())
-    if total == 0:
-        z = np.zeros(0, dtype=np.int64)
-        return z, z
-    li = np.repeat(np.arange(n, dtype=np.int64), cnt)
-    starts = np.concatenate([[0], np.cumsum(cnt)[:-1]])
-    within = np.arange(total, dtype=np.int64) - np.repeat(starts, cnt)
-    ri = order_r[np.repeat(lo, cnt) + within]
-    return li, ri
+    # native single-pass expansion (numpy repeat/cumsum twin below the
+    # calibrated crossover); order_r composes the right-side argsort
+    # indirection into the same pass
+    return expand_match_ranges(lo, hi - lo, r_map=order_r)
 
 
 def _verify_keys(
@@ -90,6 +154,8 @@ def _verify_keys(
     guard). ``l_reps``/``r_reps`` are the per-side [k, n] rep matrices
     when the caller already computed them; ``verify_numeric=False`` skips
     the numeric check for callers whose matching was already rep-exact."""
+    from hyperspace_tpu.io.columnar import _gather
+
     keep = np.ones(len(li), dtype=bool)
     for j, (lname, rname) in enumerate(on):
         lc, rc = left.column(lname), right.column(rname)
@@ -101,7 +167,7 @@ def _verify_keys(
         elif verify_numeric:
             lr = l_reps[j] if l_reps is not None else lc.key_rep()
             rr = r_reps[j] if r_reps is not None else rc.key_rep()
-            keep &= lr[li] == rr[ri]
+            keep &= _gather(lr, li) == _gather(rr, ri)
     if keep.all():
         return li, ri
     return li[keep], ri[keep]
@@ -141,6 +207,16 @@ class PreparedJoinSide:
     combined: np.ndarray  # [n] int64 (no null sentinels applied)
     nulls: Optional[np.ndarray]  # [n] bool, None when no null keys
     sorted_buckets: bool
+    # Memoized per-bucket stable sort permutations of the SENTINELED
+    # combined key, keyed by (bucket, sentinel parity). Query-independent
+    # — the sentineled key is a pure function of (combined, nulls,
+    # parity) — so a serve-cached unsorted side (hybrid tails) pays its
+    # per-bucket argsorts once, not per query. Racing fills are benign
+    # (identical values; dict assignment is atomic), the ScanCacheEntry
+    # memo doctrine.
+    sort_perms: Dict = dataclasses.field(
+        default_factory=dict, repr=False, compare=False
+    )
 
     @property
     def nbytes(self) -> int:
@@ -151,7 +227,30 @@ class PreparedJoinSide:
         n += self.sizes.nbytes + self.offs.nbytes
         if self.nulls is not None:
             n += self.nulls.nbytes
+        if not self.sorted_buckets:
+            # pre-charge the sort-perm memo at its worst case — BOTH
+            # sentinel parities (a cached side can serve as left in one
+            # query and right in another, e.g. a self-join), 8 bytes/row
+            # each: sizes are fixed at put() time, so growth must be
+            # charged up front or the byte cap stops bounding real memory
+            n += 2 * self.combined.nbytes
         return n
+
+    def bucket_sort_perm(
+        self,
+        b: int,
+        comb_slice: np.ndarray,
+        parity: int,
+        n_threads: Optional[int] = None,
+    ) -> np.ndarray:
+        """Stable argsort of one bucket's sentineled combined-key slice,
+        memoized (see ``sort_perms``)."""
+        key = (int(b), parity)
+        perm = self.sort_perms.get(key)
+        if perm is None:
+            perm = _stable_argsort_i64(comb_slice, n_threads=n_threads)
+            self.sort_perms[key] = perm
+        return perm
 
     def subset(self, buckets: Tuple[int, ...]) -> "PreparedJoinSide":
         """Restrict to a bucket subset (sides with mismatched bucket sets,
@@ -194,6 +293,7 @@ def prepare_join_side(
     """Build the cacheable serve state from per-bucket batches."""
     from hyperspace_tpu.ops.join import combine_reps_np
 
+    t0 = _time.perf_counter()
     buckets = tuple(sorted(bucket_batches))
     batch = ColumnarBatch.concat([bucket_batches[b] for b in buckets])
     sizes = np.array(
@@ -219,6 +319,7 @@ def prepare_join_side(
             ge = ge.copy()
             ge[cross_idx] = True
         sorted_buckets = bool(np.all(ge))
+    _stage_add("prepare", t0)
     return PreparedJoinSide(
         buckets=buckets,
         batch=batch,
@@ -229,6 +330,76 @@ def prepare_join_side(
         nulls=nulls,
         sorted_buckets=sorted_buckets,
     )
+
+
+def prepare_join_side_pipelined(
+    items: Iterable[Tuple[int, Callable[[], ColumnarBatch]]],
+    key_cols: List[str],
+) -> Optional[PreparedJoinSide]:
+    """Streaming twin of :func:`prepare_join_side`: consumes
+    ``(bucket, fetch)`` pairs in ascending bucket order, computing each
+    bucket's serve state (key reps, combined key, null mask, sortedness)
+    as soon as ``fetch()`` returns — while the executor's scan pool is
+    still reading later buckets. Output is bit-identical to
+    ``prepare_join_side`` over the same batches: reps/combined/nulls are
+    per-row functions, so per-bucket computation concatenates to exactly
+    the concat-then-compute result, and the global sortedness test
+    ignores bucket boundaries in both formulations. Returns None for an
+    empty stream (the executor's empty-side contract)."""
+    from hyperspace_tpu.ops.join import combine_reps_np
+
+    items = list(items)
+    if not items:
+        return None
+
+    def prep_one(item):
+        b, fetch = item
+        batch = fetch()
+        t0 = _time.perf_counter()
+        reps = batch.key_reps(key_cols)
+        nulls_m = batch.null_any(key_cols)
+        combined = combine_reps_np(reps)
+        sorted_b = len(combined) <= 1 or bool(
+            np.all(combined[1:] >= combined[:-1])
+        )
+        _stage_add("prepare", t0)
+        return b, batch, reps, nulls_m, combined, sorted_b
+
+    # Per-bucket prepare fans out on its own small pool: each worker
+    # blocks on that bucket's scan future (scan-pool tasks never wait on
+    # other scan-pool futures — the deadlock discipline lives there),
+    # then runs the reps/combine passes, whose numpy kernels release the
+    # GIL on large arrays. Scaled to cores; 1 worker degenerates to the
+    # plain in-order loop.
+    from hyperspace_tpu import native
+
+    workers = min(4, max(1, native._cores() - 1), len(items))
+    if workers > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="hs-prep"
+        ) as pool:
+            rows = list(pool.map(prep_one, items))
+    else:
+        rows = [prep_one(x) for x in items]
+    t0 = _time.perf_counter()
+    batches = [r[1] for r in rows]
+    sizes = np.array([b.num_rows for b in batches], dtype=np.int64)
+    offs = np.concatenate([[0], np.cumsum(sizes)[:-1]]).astype(np.int64)
+    any_nulls = any(bool(r[3].any()) for r in rows)
+    out = PreparedJoinSide(
+        buckets=tuple(r[0] for r in rows),
+        batch=ColumnarBatch.concat(batches),
+        sizes=sizes,
+        offs=offs,
+        reps=np.concatenate([r[2] for r in rows], axis=1),
+        combined=np.concatenate([r[4] for r in rows]),
+        nulls=np.concatenate([r[3] for r in rows]) if any_nulls else None,
+        sorted_buckets=all(r[5] for r in rows),
+    )
+    _stage_add("prepare", t0)
+    return out
 
 
 def _sentineled(prep: PreparedJoinSide, parity: int) -> np.ndarray:
@@ -254,37 +425,55 @@ def _host_match_native_presorted(
     """All-buckets-presorted fast path: native count pass per bucket,
     then each bucket's pairs are emitted with its global row-offset bias
     straight into ONE preallocated (li, ri) — no per-bucket arrays, no
-    offset-add passes, no final concatenate. Returns None (caller falls
-    back) when the native kernel is unavailable or a small workload
-    wouldn't repay the per-call overhead."""
+    offset-add passes, no final concatenate. Count and emit both fan out
+    over a thread pool at serve scale (disjoint output slices; the
+    native calls release the GIL). Returns None (caller falls back) when
+    the native kernel is unavailable or a small workload wouldn't repay
+    the per-call overhead."""
     from hyperspace_tpu import native
 
     total_rows = l_comb.shape[0] + r_comb.shape[0]
     if total_rows < _NATIVE_JOIN_MIN_ROWS or native.load(wait=False) is None:
         return None
-    counts = []
-    for b in range(len(lp.sizes)):
-        lsz, loff = int(lp.sizes[b]), int(lp.offs[b])
-        rsz, roff = int(rp.sizes[b]), int(rp.offs[b])
+    B = len(lp.sizes)
+    spans = [
+        (int(lp.sizes[b]), int(lp.offs[b]), int(rp.sizes[b]), int(rp.offs[b]))
+        for b in range(B)
+    ]
+
+    def count_one(span):
+        lsz, loff, rsz, roff = span
         if lsz == 0 or rsz == 0:
-            counts.append(0)
-            continue
-        c = native.merge_join_count_i64(
+            return 0
+        return native.merge_join_count_i64(
             l_comb[loff : loff + lsz], r_comb[roff : roff + rsz]
         )
-        if c is None:
-            return None
-        counts.append(c)
+
+    workers = _match_workers(B, total_rows)
+    t0 = _time.perf_counter()
+    if workers > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            counts = list(pool.map(count_one, spans))
+    else:
+        counts = [count_one(s) for s in spans]
+    if any(c is None for c in counts):
+        return None
+    _stage_add("match", t0)
+    t0 = _time.perf_counter()
     total = sum(counts)
     li = np.empty(total, dtype=np.int64)
     ri = np.empty(total, dtype=np.int64)
-    pos = 0
-    for b, c in enumerate(counts):
+    offs = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+
+    def emit_one(b):
+        c = counts[b]
         if c == 0:
-            continue
-        lsz, loff = int(lp.sizes[b]), int(lp.offs[b])
-        rsz, roff = int(rp.sizes[b]), int(rp.offs[b])
-        ok = native.merge_join_emit_into(
+            return True
+        lsz, loff, rsz, roff = spans[b]
+        pos = int(offs[b])
+        return native.merge_join_emit_into(
             l_comb[loff : loff + lsz],
             r_comb[roff : roff + rsz],
             li[pos : pos + c],
@@ -292,9 +481,17 @@ def _host_match_native_presorted(
             loff,
             roff,
         )
-        if not ok:
-            return None
-        pos += c
+
+    if workers > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            oks = list(pool.map(emit_one, range(B)))
+    else:
+        oks = [emit_one(b) for b in range(B)]
+    _stage_add("expand", t0)
+    if not all(oks):
+        return None
     return li, ri
 
 
@@ -317,21 +514,29 @@ def _host_match(
         pair = _host_match_native_presorted(lp, rp, l_comb, r_comb)
         if pair is not None:
             return pair
-    li_parts: List[np.ndarray] = []
-    ri_parts: List[np.ndarray] = []
-    for b in range(len(lp.sizes)):
+    from hyperspace_tpu.ops.join import expand_match_ranges
+
+    B = len(lp.sizes)
+    total_rows = l_comb.shape[0] + r_comb.shape[0]
+    workers = _match_workers(B, total_rows)
+    # when buckets fan out across threads, each per-bucket native sort
+    # gets a slice of the core budget instead of claiming the machine
+    sort_threads = None if workers == 1 else 1
+
+    def match_bucket(b):
         lsz, loff = int(lp.sizes[b]), int(lp.offs[b])
         rsz, roff = int(rp.sizes[b]), int(rp.offs[b])
         if lsz == 0 or rsz == 0:
-            continue
+            return None
+        t0 = _time.perf_counter()
         ls = l_comb[loff : loff + lsz]
         rs = r_comb[roff : roff + rsz]
         perm_l = perm_r = None
         if not l_sorted:
-            perm_l = np.argsort(ls, kind="stable")
+            perm_l = lp.bucket_sort_perm(b, ls, 0, n_threads=sort_threads)
             ls = ls[perm_l]
         if not r_sorted:
-            perm_r = np.argsort(rs, kind="stable")
+            perm_r = rp.bucket_sort_perm(b, rs, 1, n_threads=sort_threads)
             rs = rs[perm_r]
         pair = None
         if lsz + rsz >= _NATIVE_JOIN_MIN_ROWS:
@@ -343,27 +548,40 @@ def _host_match(
             pair = native.merge_join_i64(ls, rs)
         if pair is not None:
             li_sorted, ri_sorted = pair
+            _stage_add("match", t0)
             if len(li_sorted) == 0:
-                continue
-        else:
-            lo = np.searchsorted(rs, ls, side="left")
-            hi = np.searchsorted(rs, ls, side="right")
-            cnt = hi - lo
-            total = int(cnt.sum())
-            if total == 0:
-                continue
-            li_sorted = np.repeat(np.arange(lsz, dtype=np.int64), cnt)
-            starts = np.concatenate([[0], np.cumsum(cnt)[:-1]])
-            within = np.arange(total, dtype=np.int64) - np.repeat(starts, cnt)
-            ri_sorted = np.repeat(lo, cnt) + within
-        li = perm_l[li_sorted] if perm_l is not None else li_sorted
-        ri = perm_r[ri_sorted] if perm_r is not None else ri_sorted
-        li_parts.append(li + loff)
-        ri_parts.append(ri + roff)
+                return None
+            li = perm_l[li_sorted] if perm_l is not None else li_sorted
+            ri = perm_r[ri_sorted] if perm_r is not None else ri_sorted
+            return li + loff, ri + roff
+        lo = np.searchsorted(rs, ls, side="left")
+        hi = np.searchsorted(rs, ls, side="right")
+        _stage_add("match", t0)
+        t0 = _time.perf_counter()
+        li, ri = expand_match_ranges(
+            lo, hi - lo, l_map=perm_l, r_map=perm_r,
+            l_bias=loff, r_bias=roff,
+        )
+        _stage_add("expand", t0)
+        if len(li) == 0:
+            return None
+        return li, ri
+
+    if workers > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            results = list(pool.map(match_bucket, range(B)))
+    else:
+        results = [match_bucket(b) for b in range(B)]
+    pairs = [p for p in results if p is not None]
     z = np.zeros(0, dtype=np.int64)
-    if not li_parts:
+    if not pairs:
         return z, z
-    return np.concatenate(li_parts), np.concatenate(ri_parts)
+    return (
+        np.concatenate([p[0] for p in pairs]),
+        np.concatenate([p[1] for p in pairs]),
+    )
 
 
 def _device_match(
@@ -412,21 +630,32 @@ def _device_match(
             r_len = grow(r_len, 0)
             l_rowmap = grow(l_rowmap, 0)
             r_rowmap = grow(r_rowmap, 0)
+    t0 = _time.perf_counter()
     perm_l, perm_r, lo, cnt = bucketed_match_ranges(
         mesh, l_pad, l_len, r_pad, r_len, device_min_rows
     )
+    _stage_add("match", t0)
+    t0 = _time.perf_counter()
+    from hyperspace_tpu.ops.join import expand_match_ranges
+
     li_parts, ri_parts = [], []
     for b in range(len(l_len)):
         total = int(cnt[b].sum())
         if total == 0:
             continue
-        c = cnt[b]
-        li_sorted = np.repeat(np.arange(len(c), dtype=np.int64), c)
-        starts = np.concatenate([[0], np.cumsum(c)[:-1]])
-        within = np.arange(total, dtype=np.int64) - np.repeat(starts, c)
-        ri_sorted = lo[b][li_sorted] + within
-        li_parts.append(l_rowmap[b][perm_l[b][li_sorted]])
-        ri_parts.append(r_rowmap[b][perm_r[b][ri_sorted]])
+        # compose the sorted-space permutation with the pad rowmap once
+        # per bucket (O(width)), then expand ranges in a single pass:
+        # li = l_map[i], ri = r_map[lo[i]+j] — identical to the former
+        # repeat/cumsum chain plus two gather passes
+        li, ri = expand_match_ranges(
+            lo[b],
+            cnt[b],
+            l_map=l_rowmap[b][perm_l[b]],
+            r_map=r_rowmap[b][perm_r[b]],
+        )
+        li_parts.append(li)
+        ri_parts.append(ri)
+    _stage_add("expand", t0)
     z = np.zeros(0, dtype=np.int64)
     if not li_parts:
         return z, z
@@ -486,10 +715,15 @@ def co_bucketed_join_prepared(
     # numeric re-verification.
     sentinels_used = lp.nulls is not None or rp.nulls is not None
     verify_numeric = len(on) > 1 or sentinels_used
+    t0 = _time.perf_counter()
     li, ri = _verify_keys(
         lp.batch, rp.batch, on, li, ri, lp.reps, rp.reps, verify_numeric
     )
-    return _assemble(lp.batch, rp.batch, li, ri)
+    _stage_add("verify", t0)
+    t0 = _time.perf_counter()
+    out = _assemble(lp.batch, rp.batch, li, ri)
+    _stage_add("assemble", t0)
+    return out
 
 
 def co_bucketed_join(

@@ -114,6 +114,23 @@ class ServeCache:
             self._entries.clear()
             self._bytes = 0
 
+    def evict_kind(self, kind: str) -> int:
+        """Drop every entry of one kind (keys are ``(kind, …)`` tuples:
+        "scan" / "bucketed" / "joinside" / "delta"). Returns the number
+        evicted. Operational tooling: lets a serve process (or bench)
+        shed one class of state — e.g. keep the prepared hybrid delta
+        but force joinside re-preparation — without a full clear."""
+        with self._lock:
+            victims = [
+                k
+                for k in self._entries
+                if isinstance(k, tuple) and k and k[0] == kind
+            ]
+            for k in victims:
+                _, nbytes = self._entries.pop(k)
+                self._bytes -= nbytes
+            return len(victims)
+
     @property
     def resident_bytes(self) -> int:
         return self._bytes

@@ -239,7 +239,7 @@ class Column:
         return Column(
             "numeric",
             self.arrow_type,
-            values=self.values[idx],
+            values=_gather(self.values, idx),
             validity=None if self.validity is None else self.validity[idx],
         )
 
@@ -283,6 +283,55 @@ class Column:
             values=np.concatenate([c.values for c in cols]),
             validity=validity,
         )
+
+
+# At or above this index count an 8-byte-element gather dispatches to the
+# native threaded kernel (``native.gather_i64``/``gather_f64``); numpy's
+# fancy indexing is single-threaded, and the serve join's assemble stage
+# is a string of multi-million-row gathers. FALLBACK DEFAULT: the
+# effective threshold comes from the per-machine calibration probe
+# (native/calibrate.py); an explicit module-attribute override wins.
+_NATIVE_GATHER_MIN_ROWS_DEFAULT = 1 << 16
+_NATIVE_GATHER_MIN_ROWS = _NATIVE_GATHER_MIN_ROWS_DEFAULT
+
+
+def _native_gather_min_rows() -> int:
+    if _NATIVE_GATHER_MIN_ROWS != _NATIVE_GATHER_MIN_ROWS_DEFAULT:
+        return _NATIVE_GATHER_MIN_ROWS  # explicit (test/ops) override wins
+    from hyperspace_tpu.native import calibrate
+
+    return (
+        calibrate.thresholds().native_gather_min_rows
+        or _NATIVE_GATHER_MIN_ROWS
+    )
+
+
+def _gather(values: np.ndarray, idx) -> np.ndarray:
+    """``values[idx]`` with the native threaded gather for large
+    contiguous 8-byte-element arrays; numpy everywhere else. Bit-exact
+    either way: the kernel bounds-checks and returns None on any index
+    outside [0, n) (negative wrapping, IndexError), so numpy's exact
+    semantics are preserved by fallback, never emulated."""
+    if (
+        isinstance(idx, np.ndarray)
+        and idx.dtype == np.int64
+        and values.ndim == 1
+        and values.dtype.itemsize == 8
+        and values.dtype.kind in "ifuMm"
+        and values.flags.c_contiguous
+        and len(idx) >= _native_gather_min_rows()
+    ):
+        from hyperspace_tpu import native
+
+        if values.dtype == np.float64:
+            out = native.gather_f64(values, idx)
+        else:
+            out = native.gather_i64(values.view(np.int64), idx)
+            if out is not None:
+                out = out.view(values.dtype)
+        if out is not None:
+            return out
+    return values[idx]
 
 
 def column_value_range(col: "Column"):

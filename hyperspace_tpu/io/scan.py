@@ -9,11 +9,44 @@ by injecting per-file constants, the role Spark's
 
 from __future__ import annotations
 
+import threading
 from typing import List, Optional, Sequence
 
 import pyarrow as pa
 
 from hyperspace_tpu.io import parquet as pio
+
+# ---------------------------------------------------------------------------
+# Shared read-ahead pool (pipelined serve; docs/serve-pipeline.md)
+# ---------------------------------------------------------------------------
+
+_scan_pool = None
+_scan_pool_lock = threading.Lock()
+
+
+def scan_pool():
+    """The process-wide read-ahead ThreadPoolExecutor the pipelined serve
+    path submits per-bucket parquet reads (and the hybrid-scan delta
+    prepare) to. Sized for I/O overlap, not CPU count: parquet reads
+    spend most of their time in Arrow's own (GIL-releasing) decode and
+    on storage latency, so even a 2-core host profits from several
+    in-flight reads. One shared pool keeps a concurrent left+right side
+    prepare from spawning 2x the threads; tasks submitted here must
+    never block on other scan_pool futures (deadlock discipline — only
+    the consuming side threads wait)."""
+    global _scan_pool
+    if _scan_pool is None:
+        with _scan_pool_lock:
+            if _scan_pool is None:
+                import os
+                from concurrent.futures import ThreadPoolExecutor
+
+                workers = min(8, max(4, (os.cpu_count() or 1)))
+                _scan_pool = ThreadPoolExecutor(
+                    max_workers=workers,
+                    thread_name_prefix="hs-scan",
+                )
+    return _scan_pool
 
 
 def read_relation_files(

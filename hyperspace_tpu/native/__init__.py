@@ -64,6 +64,12 @@ KERNEL_TWINS = {
         "bucket_ids_i64",
         "hyperspace_tpu.ops.hash.bucket_ids_numpy",
     ),
+    "hs_expand_match_ranges_i64": (
+        "expand_match_ranges_i64",
+        "hyperspace_tpu.ops.join.expand_match_ranges_numpy",
+    ),
+    "hs_gather_i64": ("gather_i64", "numpy.take"),
+    "hs_gather_f64": ("gather_f64", "numpy.take"),
 }
 
 
@@ -90,11 +96,27 @@ def _cache_path() -> str:
     return os.path.join(_cache_dir(), f"_hs_native_{digest}.so")
 
 
+# How long another source revision's .so/.failed artifacts survive in a
+# shared cache dir before cleanup removes them. Deleting them eagerly
+# made two checkouts sharing one XDG cache recompile on every
+# alternating process start (each start destroyed the other's .so); the
+# age gate keeps every ACTIVE revision's artifacts while still
+# reclaiming truly-stale ones. "Active" is tracked via mtime: load()
+# touches the .so on every successful CDLL load (atime is unreliable —
+# relatime/noatime mounts), so a revision some process still uses never
+# ages past the threshold, while a genuinely abandoned one does.
+_SUPERSEDED_TTL_S = 7 * 24 * 3600.0
+
+
 def _cleanup_superseded(keep: str) -> None:
-    """Drop artifacts of older source revisions (the cache is keyed by a
-    source hash, so every edit would otherwise strand one .so forever —
-    a real leak on shared filesystems and baked images)."""
+    """Drop STALE artifacts of other source revisions (the cache is keyed
+    by a source hash, so every edit would otherwise strand one .so
+    forever — a real leak on shared filesystems and baked images). Only
+    artifacts older than ``_SUPERSEDED_TTL_S`` are removed: a younger
+    artifact likely belongs to another live checkout sharing this cache
+    dir (two checkouts deleting each other's .so recompile forever)."""
     pattern = os.path.join(os.path.dirname(keep), "_hs_native_*")
+    now = _time.time()
     for old in glob.glob(pattern):
         # Never touch .tmp.<pid> files: on a shared filesystem another
         # process may be mid-compile of a DIFFERENT source revision, and
@@ -102,11 +124,13 @@ def _cleanup_superseded(keep: str) -> None:
         # .failed marker. Orphaned tmps (SIGKILL) are gitignored noise.
         if ".tmp." in os.path.basename(old):
             continue
-        if not old.startswith(keep):
-            try:
+        if old.startswith(keep):
+            continue
+        try:
+            if now - os.path.getmtime(old) >= _SUPERSEDED_TTL_S:
                 os.unlink(old)
-            except OSError:
-                pass
+        except OSError:
+            pass
 
 
 def _compile(path: str) -> bool:
@@ -299,9 +323,51 @@ def load(wait: bool = True):
                 _i64p,
                 ctypes.c_int32,
             ]
+            lib.hs_expand_match_ranges_i64.restype = ctypes.c_int64
+            lib.hs_expand_match_ranges_i64.argtypes = [
+                _i64p,
+                _i64p,
+                ctypes.c_int64,
+                _i64p,
+                ctypes.c_int64,
+                _i64p,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                _i64p,
+                _i64p,
+                ctypes.c_int64,
+                ctypes.c_int32,
+            ]
+            _f64p = ctypes.POINTER(ctypes.c_double)
+            lib.hs_gather_i64.restype = ctypes.c_int
+            lib.hs_gather_i64.argtypes = [
+                _i64p,
+                ctypes.c_int64,
+                _i64p,
+                ctypes.c_int64,
+                _i64p,
+                ctypes.c_int32,
+            ]
+            lib.hs_gather_f64.restype = ctypes.c_int
+            lib.hs_gather_f64.argtypes = [
+                _f64p,
+                ctypes.c_int64,
+                _i64p,
+                ctypes.c_int64,
+                _f64p,
+                ctypes.c_int32,
+            ]
         except (OSError, AttributeError):
             _load_failed = True
             return None
+        try:
+            # refresh the liveness timestamp _cleanup_superseded gates
+            # on: a revision that only ever LOADS its cached .so must
+            # not age past the TTL and get reaped by a sibling checkout
+            os.utime(path)
+        except OSError:
+            pass
         _lib = lib
         return _lib
     finally:
@@ -459,6 +525,117 @@ def merge_join_i64(
     if total and not merge_join_emit_into(l_sorted, r_sorted, li, ri):
         return None  # pragma: no cover — would be a kernel bug
     return li, ri
+
+
+def expand_match_ranges_i64(
+    lo: np.ndarray,
+    cnt: np.ndarray,
+    total: int,
+    l_map: Optional[np.ndarray] = None,
+    r_map: Optional[np.ndarray] = None,
+    l_bias: int = 0,
+    r_bias: int = 0,
+    n_threads: Optional[int] = None,
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Expand per-left-row match ranges ``(lo, cnt)`` into (li, ri) pairs
+    with optional index maps and biases — bit-identical to the numpy
+    repeat/cumsum chain (``ops/join.expand_match_ranges_numpy``, the
+    registered twin). ``total`` must equal ``cnt.sum()`` (callers already
+    have it from the count pass); the kernel re-validates it against its
+    own prefix sum BEFORE writing, and bounds-checks the maps, so a
+    malformed call can never overrun the buffers — it returns None and
+    the numpy fallback raises the appropriate error instead."""
+    lib = load(wait=False)
+    if lib is None:
+        return None
+    lo = np.ascontiguousarray(lo, dtype=np.int64)
+    cnt = np.ascontiguousarray(cnt, dtype=np.int64)
+    _i64p = ctypes.POINTER(ctypes.c_int64)
+
+    def p(a):
+        if a is None:
+            return ctypes.cast(None, _i64p)
+        return a.ctypes.data_as(_i64p)
+
+    if l_map is not None:
+        l_map = np.ascontiguousarray(l_map, dtype=np.int64)
+    if r_map is not None:
+        r_map = np.ascontiguousarray(r_map, dtype=np.int64)
+    li = np.empty(total, dtype=np.int64)
+    ri = np.empty(total, dtype=np.int64)
+    emitted = lib.hs_expand_match_ranges_i64(
+        lo.ctypes.data_as(_i64p),
+        cnt.ctypes.data_as(_i64p),
+        ctypes.c_int64(len(lo)),
+        p(l_map),
+        ctypes.c_int64(0 if l_map is None else len(l_map)),
+        p(r_map),
+        ctypes.c_int64(0 if r_map is None else len(r_map)),
+        ctypes.c_int64(l_bias),
+        ctypes.c_int64(r_bias),
+        li.ctypes.data_as(_i64p),
+        ri.ctypes.data_as(_i64p),
+        ctypes.c_int64(total),
+        ctypes.c_int32(n_threads if n_threads else _n_threads(total)),
+    )
+    if emitted != total:
+        return None
+    return li, ri
+
+
+def _gather_64(values: np.ndarray, idx: np.ndarray) -> Optional[np.ndarray]:
+    """Shared driver of the 8-byte gathers; ``values`` dtype picks the
+    export. Returns None (numpy fallback) when the kernel is unavailable
+    or any index is out of range — numpy's negative-index wrapping and
+    IndexError semantics are preserved by falling back, never emulated."""
+    lib = load(wait=False)
+    if lib is None:
+        return None
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    out = np.empty(len(idx), dtype=values.dtype)
+    _i64p = ctypes.POINTER(ctypes.c_int64)
+    if values.dtype == np.float64:
+        _f64p = ctypes.POINTER(ctypes.c_double)
+        rc = lib.hs_gather_f64(
+            values.ctypes.data_as(_f64p),
+            ctypes.c_int64(len(values)),
+            idx.ctypes.data_as(_i64p),
+            ctypes.c_int64(len(idx)),
+            out.ctypes.data_as(_f64p),
+            ctypes.c_int32(_n_threads(len(idx))),
+        )
+    else:
+        rc = lib.hs_gather_i64(
+            values.ctypes.data_as(_i64p),
+            ctypes.c_int64(len(values)),
+            idx.ctypes.data_as(_i64p),
+            ctypes.c_int64(len(idx)),
+            out.ctypes.data_as(_i64p),
+            ctypes.c_int32(_n_threads(len(idx))),
+        )
+    if rc != 0:
+        return None
+    return out
+
+
+def gather_i64(
+    values: np.ndarray, idx: np.ndarray
+) -> Optional[np.ndarray]:
+    """Threaded bounds-checked ``values[idx]`` for contiguous int64
+    arrays — bit-exact twin of ``numpy.take`` on in-range indices. None
+    on unavailability or out-of-range indices (numpy fallback)."""
+    values = np.ascontiguousarray(values, dtype=np.int64)
+    return _gather_64(values, idx)
+
+
+def gather_f64(
+    values: np.ndarray, idx: np.ndarray
+) -> Optional[np.ndarray]:
+    """Threaded bounds-checked ``values[idx]`` for contiguous float64
+    arrays — bit-exact twin of ``numpy.take`` (bitwise moves: NaN
+    payloads survive). None on unavailability or out-of-range indices."""
+    values = np.ascontiguousarray(values, dtype=np.float64)
+    return _gather_64(values, idx)
 
 
 def bucket_ids_i64(

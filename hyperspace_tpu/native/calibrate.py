@@ -47,7 +47,7 @@ import numpy as np
 _log = logging.getLogger("hyperspace_tpu.native.calibrate")
 
 # Bump when the probe methodology changes; stale cache files re-probe.
-_PROBE_VERSION = 2
+_PROBE_VERSION = 3
 
 # Effectively-infinite row count: "this engine never loses on this
 # machine" (e.g. host vs device on a CPU backend, or a tunnel-attached
@@ -74,6 +74,8 @@ class Thresholds:
     host_hash_max_rows: int = 0
     native_hash_min_rows: int = 0
     native_partition_min_rows: int = 0
+    native_expand_min_rows: int = 0
+    native_gather_min_rows: int = 0
     source: str = "defaults"
 
 
@@ -216,6 +218,50 @@ def _probe_native_partition_min() -> int:
     return _NATIVE_PROBE_SIZES[-1] * 2
 
 
+def _probe_native_expand_min() -> int:
+    """Crossover for the match-range expansion kernel vs the numpy
+    repeat/cumsum chain — probed at the PAIR count (the dispatch unit of
+    ``ops/join.expand_match_ranges``)."""
+    from hyperspace_tpu import native
+    from hyperspace_tpu.ops import join as join_mod
+
+    if _native_lib_or_busy() is None:
+        return 0
+    rng = np.random.default_rng(46)
+    for n in _NATIVE_PROBE_SIZES:
+        # serve shape: most left rows match 0-2 right rows
+        cnt = rng.integers(0, 3, n).astype(np.int64)
+        lo = rng.integers(0, n, n).astype(np.int64)
+        total = int(cnt.sum())
+        t_native = _time_best(
+            lambda: native.expand_match_ranges_i64(lo, cnt, total)
+        )
+        t_numpy = _time_best(
+            lambda: join_mod.expand_match_ranges_numpy(lo, cnt)
+        )
+        if t_native < t_numpy:
+            return n
+    return _NATIVE_PROBE_SIZES[-1] * 2
+
+
+def _probe_native_gather_min() -> int:
+    """Crossover for the threaded native gather vs numpy fancy indexing
+    (the serve join's assemble stage), probed at the INDEX count."""
+    from hyperspace_tpu import native
+
+    if _native_lib_or_busy() is None:
+        return 0
+    rng = np.random.default_rng(47)
+    for n in _NATIVE_PROBE_SIZES:
+        src = rng.integers(-(2**62), 2**62, n, dtype=np.int64)
+        idx = rng.integers(0, n, n).astype(np.int64)
+        t_native = _time_best(lambda: native.gather_i64(src, idx))
+        t_numpy = _time_best(lambda: src[idx])
+        if t_native < t_numpy:
+            return n
+    return _NATIVE_PROBE_SIZES[-1] * 2
+
+
 def _probe_host_max(op: str, platform: str) -> int:
     """Smallest size where the device beats the host for ``op`` ("sort" |
     "hash"), extrapolated monotonic; _NEVER when the host wins at every
@@ -287,6 +333,8 @@ def _probe() -> Thresholds:
         host_hash_max_rows=_probe_host_max("hash", key["platform"]),
         native_hash_min_rows=_probe_native_hash_min(),
         native_partition_min_rows=_probe_native_partition_min(),
+        native_expand_min_rows=_probe_native_expand_min(),
+        native_gather_min_rows=_probe_native_gather_min(),
         source="calibrated",
     )
     _log.info(
@@ -313,6 +361,8 @@ def _load_cache() -> Optional[Thresholds]:
             host_hash_max_rows=int(t["host_hash_max_rows"]),
             native_hash_min_rows=int(t["native_hash_min_rows"]),
             native_partition_min_rows=int(t["native_partition_min_rows"]),
+            native_expand_min_rows=int(t["native_expand_min_rows"]),
+            native_gather_min_rows=int(t["native_gather_min_rows"]),
             source="calibrated",
         )
     except (KeyError, TypeError, ValueError):
@@ -347,6 +397,8 @@ def _store_cache(t: Thresholds) -> None:
                             "host_hash_max_rows",
                             "native_hash_min_rows",
                             "native_partition_min_rows",
+                            "native_expand_min_rows",
+                            "native_gather_min_rows",
                         )
                     },
                 },

@@ -303,6 +303,136 @@ int64_t hs_merge_join_emit_i64(const int64_t* l, int64_t n,
   return out;
 }
 
+// Expand per-left-row match ranges into explicit (li, ri) pairs — the
+// serve-side half of the merge join that the numpy path spends ~6 full
+// array passes on (repeat + cumsum + arange + repeat + gather; the
+// "repeat/cumsum chain" of execution/join_exec.py). One pass here: for
+// left row i with cnt[i] matches starting at sorted-right position
+// lo[i], emit cnt[i] pairs. Optional l_map/r_map (nullptr = identity)
+// compose the argsort/rowmap indirections the callers otherwise apply
+// as separate gather passes: li = l_map[i] + l_bias, ri =
+// r_map[lo[i]+j] + r_bias. Pair order: left row ascending, right
+// position ascending within each left row — identical to the numpy
+// expansion (ops/join.expand_match_ranges_numpy, the registered twin).
+//
+// Threading: rows are chunked by a serial prefix sum of cnt, so each
+// thread writes a disjoint contiguous output slice. `capacity` is the
+// caller's li/ri allocation (= cnt's sum, which the Python wrapper
+// already computed): it is validated BEFORE any write, so a
+// miscomputed caller total can never overrun the buffers — the same
+// defensive posture as the gathers' bounds check. Map lengths are
+// validated too (l_map positionally: l_map_len >= n; r_map per element,
+// since lo+cnt ranges are data-dependent); a violation returns -1 and
+// the Python fallback surfaces numpy's own IndexError. Returns the
+// emitted pair count, -1 on bad arguments, -2 on resource exhaustion.
+int64_t hs_expand_match_ranges_i64(const int64_t* lo, const int64_t* cnt,
+                                   int64_t n, const int64_t* l_map,
+                                   int64_t l_map_len, const int64_t* r_map,
+                                   int64_t r_map_len, int64_t l_bias,
+                                   int64_t r_bias, int64_t* li, int64_t* ri,
+                                   int64_t capacity, int32_t n_threads) {
+  if (n < 0 || (n > 0 && (lo == nullptr || cnt == nullptr))) return -1;
+  if (l_map != nullptr && l_map_len < n) return -1;
+  if (n == 0) return capacity == 0 ? 0 : -1;
+  if (n_threads < 1) n_threads = 1;
+  try {
+    // Serial prefix sum: out_off[i] = pairs emitted before row i.
+    std::vector<int64_t> out_off(static_cast<size_t>(n) + 1);
+    int64_t running = 0;
+    for (int64_t i = 0; i < n; ++i) {
+      if (cnt[i] < 0) return -1;
+      out_off[i] = running;
+      running += cnt[i];
+    }
+    out_off[n] = running;
+    const int64_t total = running;
+    if (total != capacity) return -1;
+    if (total > 0 && (li == nullptr || ri == nullptr)) return -1;
+    const int T =
+        total < (1 << 16) ? 1 : std::min<int64_t>(n_threads, n);
+    const int64_t chunk = (n + T - 1) / T;
+    std::vector<uint8_t> bad(T, 0);
+    auto expand = [&](int t) {
+      int64_t lo_row = t * chunk;
+      if (lo_row >= n) return;  // ceil-chunking can overshoot for tiny n
+      int64_t hi_row = std::min<int64_t>(n, lo_row + chunk);
+      int64_t out = out_off[lo_row];
+      for (int64_t i = lo_row; i < hi_row; ++i) {
+        const int64_t l = (l_map ? l_map[i] : i) + l_bias;
+        const int64_t base = lo[i];
+        if (r_map != nullptr &&
+            cnt[i] > 0 && (base < 0 || base + cnt[i] > r_map_len)) {
+          bad[t] = 1;
+          return;
+        }
+        for (int64_t j = 0; j < cnt[i]; ++j) {
+          li[out] = l;
+          ri[out] = (r_map ? r_map[base + j] : base + j) + r_bias;
+          ++out;
+        }
+      }
+    };
+    run_on_threads(T, expand);
+    for (int t = 0; t < T; ++t)
+      if (bad[t]) return -1;
+    return total;
+  } catch (...) {
+    return -2;
+  }
+}
+
+// Bounds-checked threaded gathers: out[i] = src[idx[i]]. numpy's fancy
+// indexing is single-threaded and the serve join's assemble stage is a
+// string of multi-million-row gathers (one per output column), so the
+// random-access latency is worth spreading over cores. Any idx outside
+// [0, n_src) returns 1 (the Python wrapper falls back to numpy, which
+// preserves numpy's negative-index and IndexError semantics exactly).
+// Returns 0 on success, 2 on resource exhaustion.
+static int gather64(const uint64_t* src, int64_t n_src, const int64_t* idx,
+                    int64_t n_idx, uint64_t* out, int32_t n_threads) {
+  if (n_src < 0 || n_idx < 0 ||
+      (n_idx > 0 && (src == nullptr || idx == nullptr || out == nullptr)))
+    return 1;
+  if (n_idx == 0) return 0;
+  if (n_threads < 1) n_threads = 1;
+  const int T = static_cast<int>(std::min<int64_t>(n_threads, n_idx));
+  try {
+    const int64_t chunk = (n_idx + T - 1) / T;
+    std::vector<uint8_t> bad(T, 0);
+    auto work = [&](int t) {
+      int64_t lo = t * chunk, hi = std::min<int64_t>(n_idx, lo + chunk);
+      for (int64_t i = lo; i < hi; ++i) {
+        const int64_t j = idx[i];
+        if (j < 0 || j >= n_src) {
+          bad[t] = 1;
+          return;
+        }
+        out[i] = src[j];
+      }
+    };
+    run_on_threads(T, work);
+    for (int t = 0; t < T; ++t)
+      if (bad[t]) return 1;
+  } catch (...) {
+    return 2;
+  }
+  return 0;
+}
+
+int hs_gather_i64(const int64_t* src, int64_t n_src, const int64_t* idx,
+                  int64_t n_idx, int64_t* out, int32_t n_threads) {
+  return gather64(reinterpret_cast<const uint64_t*>(src), n_src, idx, n_idx,
+                  reinterpret_cast<uint64_t*>(out), n_threads);
+}
+
+int hs_gather_f64(const double* src, int64_t n_src, const int64_t* idx,
+                  int64_t n_idx, double* out, int32_t n_threads) {
+  // same 8-byte move as the int64 gather; a distinct export keeps the
+  // ctypes signatures honest (and the parity registry explicit per type)
+  return gather64(reinterpret_cast<const uint64_t*>(src), n_src, idx, n_idx,
+                  reinterpret_cast<uint64_t*>(out), n_threads);
+}
+
 // MurmurHash3-32 bucket ids over k int64 key columns, one pass per row.
 // Bit-exact twin of ops/hash.bucket_ids_host (numpy) and the XLA kernel:
 // each key rep contributes its lo then hi uint32 word to the block

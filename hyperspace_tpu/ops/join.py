@@ -59,6 +59,86 @@ def combine_reps_np(reps: np.ndarray) -> np.ndarray:
     return h.view(np.int64)
 
 
+# At or above this PAIR count the range expansion uses the native
+# single-pass kernel (hyperspace_tpu/native); below it numpy's vectorized
+# repeat/cumsum passes are already microseconds. FALLBACK DEFAULT: the
+# effective threshold comes from the per-machine calibration probe
+# (native/calibrate.py); this constant applies when calibration is
+# disabled or a test overrides the module attribute (an override wins).
+_NATIVE_EXPAND_MIN_ROWS_DEFAULT = 1 << 14
+_NATIVE_EXPAND_MIN_ROWS = _NATIVE_EXPAND_MIN_ROWS_DEFAULT
+
+
+def _native_expand_min_rows() -> int:
+    if _NATIVE_EXPAND_MIN_ROWS != _NATIVE_EXPAND_MIN_ROWS_DEFAULT:
+        return _NATIVE_EXPAND_MIN_ROWS  # explicit (test/ops) override wins
+    from hyperspace_tpu.native import calibrate
+
+    return (
+        calibrate.thresholds().native_expand_min_rows
+        or _NATIVE_EXPAND_MIN_ROWS
+    )
+
+
+def expand_match_ranges_numpy(
+    lo: np.ndarray,
+    cnt: np.ndarray,
+    l_map: np.ndarray = None,
+    r_map: np.ndarray = None,
+    l_bias: int = 0,
+    r_bias: int = 0,
+):
+    """Expand per-left-row match ranges into (li, ri) pairs, pure numpy —
+    the registered twin of ``hs_expand_match_ranges_i64`` and the
+    repeat/cumsum chain the serve path always ran. Left row ``i`` with
+    ``cnt[i]`` matches starting at sorted-right position ``lo[i]`` emits
+    pairs ``(l_map[i] + l_bias, r_map[lo[i]+j] + r_bias)`` for j in
+    [0, cnt[i]); a None map is the identity. Pair order: left row
+    ascending, right position ascending within each left row."""
+    n = len(lo)
+    cnt = cnt.astype(np.int64, copy=False)
+    total = int(cnt.sum())
+    if total == 0:
+        z = np.zeros(0, dtype=np.int64)
+        return z, z
+    li = np.repeat(np.arange(n, dtype=np.int64), cnt)
+    starts = np.concatenate([[0], np.cumsum(cnt)[:-1]])
+    within = np.arange(total, dtype=np.int64) - np.repeat(starts, cnt)
+    ri = np.repeat(lo.astype(np.int64, copy=False), cnt) + within
+    if l_map is not None:
+        li = l_map[li]
+    if r_map is not None:
+        ri = r_map[ri]
+    if l_bias:
+        li = li + np.int64(l_bias)
+    if r_bias:
+        ri = ri + np.int64(r_bias)
+    return li, ri
+
+
+def expand_match_ranges(
+    lo: np.ndarray,
+    cnt: np.ndarray,
+    l_map: np.ndarray = None,
+    r_map: np.ndarray = None,
+    l_bias: int = 0,
+    r_bias: int = 0,
+):
+    """Host dispatch of the match-range expansion: the native single-pass
+    kernel at or above the calibrated pair-count crossover, else the
+    numpy twin — identical output either way."""
+    total = int(cnt.sum())
+    if total >= _native_expand_min_rows():
+        from hyperspace_tpu import native
+
+        pair = native.expand_match_ranges_i64(
+            lo, cnt, total, l_map, r_map, l_bias, r_bias
+        )
+        if pair is not None:
+            return pair
+    return expand_match_ranges_numpy(lo, cnt, l_map, r_map, l_bias, r_bias)
+
+
 def _bucket_join(l_rep, l_len, r_rep, r_len):
     """One padded bucket pair -> (perm_l, perm_r, lo, cnt) in sorted space.
 

@@ -10,6 +10,14 @@ Reference: ``covering/CoveringIndexRuleUtils.scala:146-288`` —
   ``Filter(Not(In(_data_file_id, deletedIds)))`` (`:244-253`) — pushed
   into the scan here (``Relation.excluded_file_ids``, applied by
   ``execution/executor._exec_scan``).
+
+Serve side (docs/serve-pipeline.md): on a co-bucketed join the executor
+prepares the appended-files delta (read + re-bucket) CONCURRENTLY with
+the index-side bucket reads and caches the per-bucket parts keyed by the
+delta file fingerprint (``executor._prepare_delta``), so repeated hybrid
+queries on a stable appended state pay only the per-bucket merge. The
+appended relation is tagged ``hybridDelta`` in its options so tooling
+and tests can identify the delta scan without shape-guessing.
 """
 
 from __future__ import annotations
@@ -42,7 +50,10 @@ def transform_plan_to_use_hybrid_scan(
     if not appended:
         return Project(data_cols, index_scan)
     appended_rel = dataclasses.replace(
-        scan.relation, files=tuple(appended), index_info=None
+        scan.relation,
+        files=tuple(appended),
+        index_info=None,
+        options=scan.relation.options + (("hybridDelta", "1"),),
     )
     return Union(
         Project(data_cols, index_scan),

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Tiny-row, CPU-only bench smoke: exercises the full serve pipeline
+# (build, point filter, co-bucketed join, serve cache, hybrid scan with
+# cached delta, delta refresh, z-order, data skipping) end to end in
+# about a minute, so the pipelined code paths run on every CI pass —
+# not only in the 4M-row chip benches. The numbers are NOT meaningful
+# (tiny rows, host backend); the exit code and the single JSON line are.
+#
+# Usage: scripts/bench_smoke.sh  [rows]   (default 100000)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+ROWS="${1:-${HS_BENCH_ROWS:-100000}}"
+if [ "$ROWS" -gt 100000 ]; then
+    echo "bench_smoke: capping rows at 100000 (got $ROWS)" >&2
+    ROWS=100000
+fi
+JAX_PLATFORMS=cpu \
+HS_BENCH_ROWS="$ROWS" \
+HS_BENCH_REPS="${HS_BENCH_REPS:-2}" \
+HS_BENCH_LADDER="$ROWS" \
+exec python bench.py

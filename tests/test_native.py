@@ -282,6 +282,227 @@ class TestPresortedFastPath:
             )
 
 
+class TestExpandMatchRangesParity:
+    """hs_expand_match_ranges_i64 vs the numpy repeat/cumsum twin
+    (ops/join.expand_match_ranges_numpy) — bit-exact, including the
+    l_map/r_map indirections and biases the serve call sites use."""
+
+    def _check(self, lo, cnt, l_map=None, r_map=None, l_bias=0, r_bias=0):
+        from hyperspace_tpu.ops.join import expand_match_ranges_numpy
+
+        lo = np.asarray(lo, dtype=np.int64)
+        cnt = np.asarray(cnt, dtype=np.int64)
+        total = int(cnt.sum())
+        got = native.expand_match_ranges_i64(
+            lo, cnt, total, l_map, r_map, l_bias, r_bias
+        )
+        assert got is not None
+        ref = expand_match_ranges_numpy(lo, cnt, l_map, r_map, l_bias, r_bias)
+        np.testing.assert_array_equal(got[0], ref[0])
+        np.testing.assert_array_equal(got[1], ref[1])
+
+    def test_empty(self):
+        self._check([], [])
+
+    def test_no_matches(self):
+        self._check([0, 3, 5], [0, 0, 0])
+
+    def test_all_match(self):
+        # every left row matches the whole right side (cross-product
+        # bucket): lo=0, cnt=m for all rows
+        m = 37
+        self._check(np.zeros(50, dtype=np.int64), np.full(50, m))
+
+    def test_skewed_counts(self):
+        # long zero runs around one huge range — the shape a skewed key
+        # produces; exercises per-thread chunks with empty output slices
+        cnt = np.zeros(10_000, dtype=np.int64)
+        cnt[7_000] = 200_000
+        lo = np.arange(10_000, dtype=np.int64)
+        self._check(lo, cnt)
+
+    def test_maps_and_biases(self):
+        rng = np.random.default_rng(23)
+        n = 50_000
+        cnt = rng.integers(0, 4, n)
+        lo = rng.integers(0, n, n)
+        l_map = rng.permutation(n).astype(np.int64)
+        r_map = rng.permutation(n + 4).astype(np.int64)
+        self._check(lo, cnt, l_map, r_map, l_bias=1000, r_bias=-7)
+
+    def test_negative_cnt_rejected(self):
+        got = native.expand_match_ranges_i64(
+            np.zeros(2, dtype=np.int64),
+            np.array([1, -1], dtype=np.int64),
+            0,
+        )
+        assert got is None
+
+    def test_tiny_n_huge_counts_threaded(self):
+        # few rows, pair count far above the threading threshold: the
+        # ceil-chunking makes trailing thread chunks start past n, which
+        # must be a no-op, not an out-of-bounds prefix-sum read
+        self._check(np.zeros(5, dtype=np.int64), np.full(5, 60_000))
+
+    def test_mismatched_total_rejected_before_writing(self):
+        # the kernel re-validates capacity against its own prefix sum
+        # BEFORE any write (a lying caller must not overrun li/ri)
+        lo = np.zeros(3, dtype=np.int64)
+        cnt = np.full(3, 10, dtype=np.int64)
+        assert native.expand_match_ranges_i64(lo, cnt, 5) is None
+        assert native.expand_match_ranges_i64(lo, cnt, 31) is None
+
+    def test_short_maps_rejected(self):
+        lo = np.array([0, 2], dtype=np.int64)
+        cnt = np.array([2, 2], dtype=np.int64)
+        short = np.zeros(3, dtype=np.int64)  # lo+cnt reaches 4
+        assert (
+            native.expand_match_ranges_i64(lo, cnt, 4, r_map=short) is None
+        )
+        assert (
+            native.expand_match_ranges_i64(
+                lo, cnt, 4, l_map=np.zeros(1, dtype=np.int64)
+            )
+            is None
+        )
+
+    def test_dispatch_native_off_leg(self, monkeypatch):
+        """ops/join.expand_match_ranges output is identical with
+        HS_NATIVE=0 (numpy twin leg) and with the kernel loaded."""
+        from hyperspace_tpu.ops import join as join_mod
+
+        rng = np.random.default_rng(29)
+        n = 60_000
+        cnt = rng.integers(0, 3, n).astype(np.int64)
+        lo = rng.integers(0, n, n).astype(np.int64)
+        monkeypatch.setattr(join_mod, "_NATIVE_EXPAND_MIN_ROWS", 1)
+        with_native = join_mod.expand_match_ranges(lo, cnt)
+        monkeypatch.setenv("HS_NATIVE", "0")
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_load_failed", False)
+        without = join_mod.expand_match_ranges(lo, cnt)
+        np.testing.assert_array_equal(with_native[0], without[0])
+        np.testing.assert_array_equal(with_native[1], without[1])
+
+
+class TestGatherParity:
+    """hs_gather_i64 / hs_gather_f64 vs numpy.take — bit-exact moves
+    (NaN payloads survive the f64 leg), with out-of-range indices
+    rejected so the Column.take dispatch preserves numpy semantics by
+    falling back."""
+
+    def test_i64_random(self):
+        rng = np.random.default_rng(31)
+        src = rng.integers(-(2**62), 2**62, 100_000, dtype=np.int64)
+        idx = rng.integers(0, len(src), 250_000).astype(np.int64)
+        np.testing.assert_array_equal(
+            native.gather_i64(src, idx), np.take(src, idx)
+        )
+
+    def test_f64_random_with_nans(self):
+        rng = np.random.default_rng(37)
+        src = rng.normal(size=50_000)
+        src[::97] = np.nan
+        src[1::97] = -0.0
+        idx = rng.integers(0, len(src), 120_000).astype(np.int64)
+        got = native.gather_f64(src, idx)
+        np.testing.assert_array_equal(
+            got.view(np.int64), np.take(src, idx).view(np.int64)
+        )
+
+    def test_empty_idx(self):
+        src = np.arange(10, dtype=np.int64)
+        got = native.gather_i64(src, np.zeros(0, dtype=np.int64))
+        assert got is not None and len(got) == 0
+
+    def test_single_element_source(self):
+        src = np.array([42], dtype=np.int64)
+        idx = np.zeros(1000, dtype=np.int64)
+        np.testing.assert_array_equal(native.gather_i64(src, idx), src[idx])
+
+    def test_out_of_range_rejected(self):
+        src = np.arange(100, dtype=np.int64)
+        assert native.gather_i64(src, np.array([100], np.int64)) is None
+        assert native.gather_i64(src, np.array([-1], np.int64)) is None
+        assert native.gather_f64(src.astype(np.float64),
+                                 np.array([-5], np.int64)) is None
+
+    def test_column_take_dispatch_parity(self, monkeypatch):
+        """Column.take output is identical above the native-gather
+        threshold and with HS_NATIVE=0 — including negative indices,
+        which the kernel rejects and numpy wraps."""
+        from hyperspace_tpu.io import columnar as col_mod
+
+        rng = np.random.default_rng(41)
+        n = 80_000
+        col = col_mod.Column(
+            "numeric", __import__("pyarrow").int64(),
+            values=rng.integers(-(2**40), 2**40, n),
+        )
+        idx = rng.integers(-n, n, 200_000).astype(np.int64)  # negatives wrap
+        monkeypatch.setattr(col_mod, "_NATIVE_GATHER_MIN_ROWS", 1)
+        with_native = col.take(idx).values
+        monkeypatch.setenv("HS_NATIVE", "0")
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_load_failed", False)
+        without = col.take(idx).values
+        np.testing.assert_array_equal(with_native, without)
+
+
+class TestCleanupSupersededTTL:
+    """Artifacts of OTHER source revisions sharing a cache dir survive
+    until the age threshold — two checkouts must stop recompiling on
+    every alternating process start (round-5 advisor finding)."""
+
+    def test_young_foreign_artifact_survives(self, tmp_path):
+        keep = str(tmp_path / "_hs_native_aaaa.so")
+        young = tmp_path / "_hs_native_bbbb.so"
+        young.write_bytes(b"x")
+        stale = tmp_path / "_hs_native_cccc.so"
+        stale.write_bytes(b"x")
+        old = native._time.time() - 2 * native._SUPERSEDED_TTL_S
+        os.utime(stale, (old, old))
+        stale_failed = tmp_path / "_hs_native_dddd.so.failed"
+        stale_failed.write_text("boom")
+        os.utime(stale_failed, (old, old))
+        tmp_marker = tmp_path / "_hs_native_eeee.so.tmp.123"
+        tmp_marker.write_bytes(b"x")
+        os.utime(tmp_marker, (old, old))
+        native._cleanup_superseded(keep)
+        assert young.exists()  # another live checkout's kernel
+        assert not stale.exists()  # genuinely abandoned revision
+        assert not stale_failed.exists()
+        assert tmp_marker.exists()  # mid-compile files are never touched
+
+    def test_load_refreshes_so_mtime(self, monkeypatch):
+        """A revision that only ever LOADS its cached .so must keep a
+        fresh mtime (the liveness signal the TTL gates on), or a sibling
+        checkout reaps it after 7 days and the recompile ping-pong the
+        TTL exists to stop comes back."""
+        if native.load() is None:
+            pytest.skip("native unavailable")
+        path = native._cache_path()
+        old = native._time.time() - 2 * native._SUPERSEDED_TTL_S
+        os.utime(path, (old, old))
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_load_failed", False)
+        assert native.load() is not None
+        age = native._time.time() - os.path.getmtime(path)
+        assert age < native._SUPERSEDED_TTL_S / 2
+
+    def test_own_artifacts_never_removed(self, tmp_path):
+        keep = str(tmp_path / "_hs_native_aaaa.so")
+        own = tmp_path / "_hs_native_aaaa.so"
+        own.write_bytes(b"x")
+        own_failed = tmp_path / "_hs_native_aaaa.so.failed"
+        own_failed.write_text("boom")
+        old = native._time.time() - 2 * native._SUPERSEDED_TTL_S
+        for f in (own, own_failed):
+            os.utime(f, (old, old))
+        native._cleanup_superseded(keep)
+        assert own.exists() and own_failed.exists()
+
+
 class TestBucketIdsParity:
     def _check(self, reps, num_buckets, seed=42):
         import hyperspace_tpu.ops.hash as hash_mod

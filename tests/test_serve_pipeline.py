@@ -1,0 +1,367 @@
+"""Pipelined join serve path (docs/serve-pipeline.md).
+
+Differential doctrine: the pipelined serve (concurrent sides, per-bucket
+scan/prepare overlap, off-critical-path hybrid delta) must return
+BIT-IDENTICAL results to the sequential path — same rows, same order,
+same string re-verification, same lineage handling — and the overlap
+must be real (proven with an injected slow reader), not just plumbing.
+"""
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+from hyperspace_tpu.io.columnar import ColumnarBatch
+
+
+@pytest.fixture
+def s1(session_factory):
+    """Single-device session (the pipelined host serve path; the mesh8
+    device-match matrix is covered by test_device_join_paths)."""
+    return session_factory(1)
+
+
+def _tables(tmp_path, n=40_000, n_orders=5_000, n_files=4):
+    rng = np.random.default_rng(17)
+    idir, odir = tmp_path / "items", tmp_path / "orders"
+    idir.mkdir()
+    odir.mkdir()
+    items = pa.table(
+        {
+            "k": rng.integers(0, n_orders, n).astype(np.int64),
+            "q": rng.integers(1, 51, n).astype(np.int64),
+            "price": rng.normal(100.0, 10.0, n),
+            "tag": pa.array(
+                rng.choice(["alpha", "beta", "gamma", "delta"], n)
+            ),
+        }
+    )
+    orders = pa.table(
+        {
+            "ok": np.arange(n_orders, dtype=np.int64),
+            "cust": rng.integers(0, 500, n_orders).astype(np.int64),
+        }
+    )
+    for i in range(n_files):
+        lo, hi = i * n // n_files, (i + 1) * n // n_files
+        pq.write_table(items.slice(lo, hi - lo), str(idir / f"p{i}.parquet"))
+        lo = i * n_orders // n_files
+        hi = (i + 1) * n_orders // n_files
+        pq.write_table(orders.slice(lo, hi - lo), str(odir / f"p{i}.parquet"))
+    return str(idir), str(odir)
+
+
+def _indexed_session(s, idir, odir):
+    hs = Hyperspace(s)
+    items = s.read.parquet(idir)
+    orders = s.read.parquet(odir)
+    hs.create_index(items, CoveringIndexConfig("i1", ["k"], ["q", "price", "tag"]))
+    hs.create_index(orders, CoveringIndexConfig("o1", ["ok"], ["cust"]))
+    s.enable_hyperspace()
+    return hs, items, orders
+
+
+def _join(s, orders, items):
+    return (
+        orders.join(items, on=orders["ok"] == items["k"])
+        .select("ok", "cust", "q", "price", "tag")
+        .collect()
+    )
+
+
+class TestPipelineBitIdentity:
+    def test_join_identical_with_pipeline_on_and_off(self, s1, tmp_path):
+        idir, odir = _tables(tmp_path)
+        _, items, orders = _indexed_session(s1, idir, odir)
+        plan = orders.join(items, on=orders["ok"] == items["k"]).explain()
+        assert plan.count("Hyperspace(Type: CI") == 2, plan
+        r_pipe = _join(s1, orders, items)
+        s1.conf.set(C.SERVE_PIPELINE_ENABLED, False)
+        r_seq = _join(s1, orders, items)
+        assert r_pipe.equals(r_seq)  # rows AND order
+
+    def test_hybrid_append_identical_and_string_verified(self, s1, tmp_path):
+        idir, odir = _tables(tmp_path)
+        _, items, orders = _indexed_session(s1, idir, odir)
+        rng = np.random.default_rng(3)
+        extra = pa.table(
+            {
+                "k": rng.integers(0, 5_000, 3_000).astype(np.int64),
+                "q": np.full(3_000, 7, dtype=np.int64),
+                "price": np.full(3_000, 1.0),
+                "tag": pa.array(np.full(3_000, "omega")),
+            }
+        )
+        pq.write_table(extra, idir + "/appended.parquet")
+        s1.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, True)
+        s1.index_manager.clear_cache()
+        items2 = s1.read.parquet(idir)
+        plan = orders.join(items2, on=orders["ok"] == items2["k"]).explain()
+        assert plan.count("Hyperspace(Type: CI") == 2, plan
+        r_pipe = _join(s1, orders, items2)
+        s1.conf.set(C.SERVE_PIPELINE_ENABLED, False)
+        r_seq = _join(s1, orders, items2)
+        assert r_pipe.equals(r_seq)
+        # the string payload column rode through dictionary concat on
+        # both paths; the appended rows must be present
+        assert "omega" in set(r_pipe.column("tag").to_pylist())
+
+    def test_string_key_join_identical(self, s1, tmp_path):
+        """String JOIN keys force the murmur-collision re-verify leg of
+        _verify_keys on both paths."""
+        rng = np.random.default_rng(7)
+        idir, odir = tmp_path / "si", tmp_path / "so"
+        idir.mkdir()
+        odir.mkdir()
+        keys = [f"user-{i}" for i in range(500)]
+        left = pa.table(
+            {
+                "name": pa.array(rng.choice(keys, 20_000)),
+                "v": rng.integers(0, 100, 20_000).astype(np.int64),
+            }
+        )
+        right = pa.table(
+            {"uname": pa.array(keys), "score": rng.normal(0, 1, len(keys))}
+        )
+        for i in range(2):
+            pq.write_table(
+                left.slice(i * 10_000, 10_000), str(idir / f"p{i}.parquet")
+            )
+            pq.write_table(
+                right.slice(i * 250, 250), str(odir / f"p{i}.parquet")
+            )
+        hs = Hyperspace(s1)
+        ldf, rdf = s1.read.parquet(str(idir)), s1.read.parquet(str(odir))
+        hs.create_index(ldf, CoveringIndexConfig("si", ["name"], ["v"]))
+        hs.create_index(rdf, CoveringIndexConfig("so", ["uname"], ["score"]))
+        s1.enable_hyperspace()
+
+        def q():
+            return (
+                ldf.join(rdf, on=ldf["name"] == rdf["uname"])
+                .select("name", "v", "score")
+                .collect()
+            )
+
+        r_pipe = q()
+        s1.conf.set(C.SERVE_PIPELINE_ENABLED, False)
+        assert q().equals(r_pipe)
+
+    def test_delete_compensation_falls_back_and_matches(self, s1, tmp_path):
+        """Hybrid DELETE compensation (lineage NOT-IN) breaks the clean
+        shape: the pipelined gate must fall back to the sequential path
+        — never a wrong answer, never a crash."""
+        import os
+
+        idir, odir = _tables(tmp_path)
+        s1.conf.set(C.INDEX_LINEAGE_ENABLED, True)
+        _, items, orders = _indexed_session(s1, idir, odir)
+        os.unlink(idir + "/p3.parquet")  # delete a source file
+        s1.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, True)
+        s1.conf.set(C.INDEX_HYBRID_SCAN_MAX_DELETED_RATIO, 1.0)
+        s1.index_manager.clear_cache()
+        items2 = s1.read.parquet(idir)
+        r_pipe = _join(s1, orders, items2)
+        s1.conf.set(C.SERVE_PIPELINE_ENABLED, False)
+        r_seq = _join(s1, orders, items2)
+        assert r_pipe.equals(r_seq)
+
+
+class TestPreparePipelinedUnit:
+    """prepare_join_side_pipelined vs prepare_join_side over the same
+    batches — every PreparedJoinSide field bit-identical, including the
+    string-dictionary concat, null masks and per-bucket sortedness."""
+
+    def _random_buckets(self, rng, sorted_buckets):
+        batches = {}
+        for b in range(5):
+            n = int(rng.integers(0, 2_000))
+            keys = rng.integers(-50, 50, n).astype(np.int64)
+            if sorted_buckets:
+                keys = np.sort(keys)
+            mask = rng.random(n) < 0.05
+            arr = pa.array(
+                np.where(mask, 0, keys), mask=mask, type=pa.int64()
+            )
+            tags = pa.array(rng.choice(["x", "y", "z"], n))
+            batches[b] = ColumnarBatch.from_arrow(
+                pa.table({"k": arr, "tag": tags})
+            )
+        return batches
+
+    @pytest.mark.parametrize("sorted_buckets", [True, False])
+    def test_fields_identical(self, sorted_buckets):
+        from hyperspace_tpu.execution.join_exec import (
+            prepare_join_side,
+            prepare_join_side_pipelined,
+        )
+
+        rng = np.random.default_rng(13)
+        batches = self._random_buckets(rng, sorted_buckets)
+        seq = prepare_join_side(batches, ["k"])
+        pipe = prepare_join_side_pipelined(
+            [(b, (lambda bb=bb: bb)) for b, bb in sorted(batches.items())],
+            ["k"],
+        )
+        assert pipe.buckets == seq.buckets
+        np.testing.assert_array_equal(pipe.sizes, seq.sizes)
+        np.testing.assert_array_equal(pipe.offs, seq.offs)
+        np.testing.assert_array_equal(pipe.reps, seq.reps)
+        np.testing.assert_array_equal(pipe.combined, seq.combined)
+        assert (pipe.nulls is None) == (seq.nulls is None)
+        if pipe.nulls is not None:
+            np.testing.assert_array_equal(pipe.nulls, seq.nulls)
+        assert pipe.sorted_buckets == seq.sorted_buckets
+        assert pipe.batch.to_arrow().equals(seq.batch.to_arrow())
+
+    def test_empty_stream_returns_none(self):
+        from hyperspace_tpu.execution.join_exec import (
+            prepare_join_side_pipelined,
+        )
+
+        assert prepare_join_side_pipelined([], ["k"]) is None
+
+
+class TestScanPrepareOverlap:
+    def test_slow_reader_overlaps_prepare(self, s1, tmp_path, monkeypatch):
+        """Injected slow reader: scan of bucket i+1 must still be in
+        flight when prepare of bucket i starts (the pipelined serve's
+        core claim), and the result must equal the sequential path's."""
+        from hyperspace_tpu.execution import executor as ex
+
+        idir, odir = _tables(tmp_path)
+        _, items, orders = _indexed_session(s1, idir, odir)
+        r_seq_holder = {}
+        s1.conf.set(C.SERVE_PIPELINE_ENABLED, False)
+        r_seq_holder["r"] = _join(s1, orders, items)
+        s1.conf.set(C.SERVE_PIPELINE_ENABLED, True)
+
+        events = []
+        ev_lock = threading.Lock()
+        real_read = ex.pio.read_table
+
+        def slow_read(paths, *a, **k):
+            t0 = time.perf_counter()
+            time.sleep(0.15)
+            out = real_read(paths, *a, **k)
+            with ev_lock:
+                events.append(("scan", t0, time.perf_counter()))
+            return out
+
+        import hyperspace_tpu.execution.join_exec as je
+
+        real_prepare = je.prepare_join_side_pipelined
+
+        def traced_prepare(items_stream, key_cols):
+            def trace(fetch):
+                def run():
+                    batch = fetch()
+                    with ev_lock:
+                        events.append(
+                            ("prep_start", time.perf_counter(), None)
+                        )
+                    return batch
+
+                return run
+
+            return real_prepare(
+                [(b, trace(f)) for b, f in items_stream], key_cols
+            )
+
+        monkeypatch.setattr(ex.pio, "read_table", slow_read)
+        monkeypatch.setattr(
+            je, "prepare_join_side_pipelined", traced_prepare
+        )
+        r_pipe = _join(s1, orders, items)
+        assert r_pipe.equals(r_seq_holder["r"])
+        scans = [e for e in events if e[0] == "scan"]
+        preps = [e for e in events if e[0] == "prep_start"]
+        assert len(scans) >= 8 and preps, events
+        # overlap: some bucket's prepare began while a later-finishing
+        # scan was still running
+        last_scan_end = max(e[2] for e in scans)
+        first_prep = min(e[1] for e in preps)
+        assert first_prep < last_scan_end, (
+            "no scan/prepare overlap: first prepare at "
+            f"{first_prep}, last scan ended {last_scan_end}"
+        )
+        # and the scans themselves overlapped (read-ahead, not serial)
+        scans_sorted = sorted(scans, key=lambda e: e[1])
+        overlapping = any(
+            scans_sorted[i + 1][1] < scans_sorted[i][2]
+            for i in range(len(scans_sorted) - 1)
+        )
+        assert overlapping, "bucket reads ran strictly serially"
+
+
+class TestDeltaCache:
+    def test_delta_entry_cached_and_reused(self, s1, tmp_path, monkeypatch):
+        """With serve-server mode on, the prepared hybrid delta is cached
+        by file fingerprint: evicting every OTHER entry kind must not
+        cause the appended file to be re-read."""
+        from hyperspace_tpu.execution import executor as ex
+
+        idir, odir = _tables(tmp_path)
+        _, items, orders = _indexed_session(s1, idir, odir)
+        rng = np.random.default_rng(9)
+        extra = pa.table(
+            {
+                "k": rng.integers(0, 5_000, 2_000).astype(np.int64),
+                "q": np.full(2_000, 9, dtype=np.int64),
+                "price": np.full(2_000, 2.0),
+                "tag": pa.array(np.full(2_000, "late")),
+            }
+        )
+        appended_path = idir + "/appended.parquet"
+        pq.write_table(extra, appended_path)
+        s1.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, True)
+        s1.conf.set(C.SERVE_CACHE_ENABLED, True)
+        s1.index_manager.clear_cache()
+        items2 = s1.read.parquet(idir)
+        baseline = _join(s1, orders, items2)
+        cache = s1.serve_cache
+        kinds = {k[0] for k in cache._entries}
+        assert "delta" in kinds, kinds
+        # drop everything except the delta; count appended-file reads
+        for kind in ("joinside", "bucketed", "scan"):
+            cache.evict_kind(kind)
+        reads = []
+        real_read = ex.pio.read_table
+
+        def counting_read(paths, *a, **k):
+            reads.extend(
+                p for p in paths if str(p).endswith("appended.parquet")
+            )
+            return real_read(paths, *a, **k)
+
+        monkeypatch.setattr(ex.pio, "read_table", counting_read)
+        again = _join(s1, orders, items2)
+        assert again.equals(baseline)
+        assert not reads, "appended delta re-read despite cached entry"
+        # appending ANOTHER file re-keys the delta entry (fingerprint)
+        monkeypatch.undo()
+        pq.write_table(extra, idir + "/appended2.parquet")
+        s1.index_manager.clear_cache()
+        items3 = s1.read.parquet(idir)
+        r3 = _join(s1, orders, items3)
+        assert r3.num_rows == baseline.num_rows + 2_000
+
+    def test_evict_kind(self):
+        from hyperspace_tpu.execution.serve_cache import ServeCache
+
+        c = ServeCache(max_bytes=1000)
+        c.put(("delta", 1), "a", 10)
+        c.put(("joinside", 1), "b", 10)
+        c.put(("joinside", 2), "c", 10)
+        assert c.evict_kind("joinside") == 2
+        assert c.get(("delta", 1)) == "a"
+        assert c.get(("joinside", 1)) is None
+        assert c.resident_bytes == 10
