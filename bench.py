@@ -414,14 +414,51 @@ def main() -> None:
         if "Hyperspace(Type: ZOCI" not in plan:
             log(f"WARNING: z-order range not index-served:\n{plan}")
         z_rows = q_zrange(items3).collect().num_rows
-        zrange_idx = timeit(lambda: q_zrange(items3).collect(), reps)
+        # INTERLEAVED A/B (round-7 protocol): rangeprune on vs off
+        # alternate within one process, so page-cache/allocator drift
+        # hits both legs equally. The "off" leg is the pre-range-plane
+        # serve path (full index read + interpreter mask), the "on" leg
+        # is zone-map file/row-group pruning + the fused residual mask.
+        t_on, t_off = [], []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            q_zrange(items3).collect()
+            t_on.append(time.perf_counter() - t0)
+            session.conf.set(C.SERVE_RANGEPRUNE_ENABLED, False)
+            t0 = time.perf_counter()
+            q_zrange(items3).collect()
+            t_off.append(time.perf_counter() - t0)
+            session.conf.unset(C.SERVE_RANGEPRUNE_ENABLED)
+
+        def _stats(ts):
+            q1, med, q3 = np.percentile(ts, [25, 50, 75])
+            return {"p50": float(med), "iqr": float(q3 - q1), "n": len(ts)}
+
+        zrange_idx = _stats(t_on)
+        zrange_off = _stats(t_off)
+        # pruning telemetry of the last rangeprune-on run: refresh it
+        # (the off leg overwrote nothing — pruning was disabled — but be
+        # explicit and re-run one pruned serve before reading)
+        from hyperspace_tpu.indexes import zonemaps as _zonemaps
+
+        q_zrange(items3).collect()
+        zprune = dict(_zonemaps.last_prune_stats)
+        zmaps_seen = (
+            zprune.get("zonemap_files_sidecar", 0)
+            + zprune.get("zonemap_files_footer", 0)
+        )
+        zprune["zonemap_hit_rate"] = round(
+            zprune.get("zonemap_files_sidecar", 0) / zmaps_seen, 3
+        ) if zmaps_seen else 0.0
         session.disable_hyperspace()
         assert q_zrange(items3).collect().num_rows == z_rows
         zrange_raw = timeit(lambda: q_zrange(items3).collect(), reps)
         log(
-            f"z-order range p50: indexed {zrange_idx['p50'] * 1e3:.1f}ms vs "
+            f"z-order range p50: indexed {zrange_idx['p50'] * 1e3:.1f}ms "
+            f"(rangeprune off {zrange_off['p50'] * 1e3:.1f}ms) vs "
             f"unindexed {zrange_raw['p50'] * 1e3:.1f}ms "
-            f"({zrange_raw['p50'] / zrange_idx['p50']:.2f}x, {z_rows:,} rows)"
+            f"({zrange_raw['p50'] / zrange_idx['p50']:.2f}x, {z_rows:,} rows); "
+            f"prune: {zprune}"
         )
         # the z-index also covers l_shipdate and would win the scoring
         # race below; the data-skipping row must measure DS serving
@@ -596,6 +633,9 @@ def main() -> None:
                     "zorder_range_speedup": round(
                         zrange_raw["p50"] / zrange_idx["p50"], 3
                     ),
+                    "zorder_range_pruneoff_p50_ms": ms(zrange_off),
+                    "zorder_range_pruneoff_iqr_ms": iqr_ms(zrange_off),
+                    "zorder_prune": zprune,
                     "zorder_range_rows_out": z_rows,
                     "ds_prune_indexed_p50_ms": ms(ds_idx_t),
                     "ds_prune_indexed_iqr_ms": iqr_ms(ds_idx_t),

@@ -90,6 +90,11 @@ class CreateAction(Action):
             ctx, self.df, self._enriched_properties()
         )
         index.write(ctx, index_data)
+        # zone-map sidecar for the range serve plane (best-effort: the
+        # serve path backfills from parquet footers when absent)
+        from hyperspace_tpu.indexes import zonemaps
+
+        zonemaps.capture_safely(self.index_data_path, index)
         self._index = index
 
     def _enriched_properties(self) -> Dict[str, str]:

@@ -84,6 +84,11 @@ class OptimizeAction(Action):
         ctx = IndexerContext(self.session, self.tracker, self.index_data_path)
         files, self._ignored = self._partition_files()
         self._previous.derived_dataset.optimize(ctx, files)
+        from hyperspace_tpu.indexes import zonemaps
+
+        zonemaps.capture_safely(
+            self.index_data_path, self._previous.derived_dataset
+        )
 
     def log_entry(self) -> IndexLogEntry:
         new_content = Content.from_directory_scan(

@@ -177,6 +177,9 @@ class RefreshAction(RefreshActionBase):
         ctx = IndexerContext(self.session, self.tracker, self.index_data_path)
         df = self._df_over(list(self.source_relation().plan_relation.files))
         self._index = self._previous.derived_dataset.refresh_full(ctx, df)
+        from hyperspace_tpu.indexes import zonemaps
+
+        zonemaps.capture_safely(self.index_data_path, self._index)
 
     def log_entry(self) -> IndexLogEntry:
         content = Content.from_directory_scan(self.index_data_path, self.tracker)
@@ -214,6 +217,11 @@ class RefreshIncrementalAction(RefreshActionBase):
         self._index, self._mode = index.refresh_incremental(
             ctx, appended_df, deleted_ids, self._previous.content
         )
+        # new version dir only: files from earlier versions keep their own
+        # sidecars (MERGE mode), so zone maps stay consistent per dir
+        from hyperspace_tpu.indexes import zonemaps
+
+        zonemaps.capture_safely(self.index_data_path, self._index)
 
     def log_entry(self) -> IndexLogEntry:
         new_content = Content.from_directory_scan(

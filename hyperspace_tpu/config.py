@@ -185,6 +185,12 @@ class Config:
         )
 
     @property
+    def serve_rangeprune_enabled(self) -> bool:
+        return self.get_bool(
+            C.SERVE_RANGEPRUNE_ENABLED, C.SERVE_RANGEPRUNE_ENABLED_DEFAULT
+        )
+
+    @property
     def default_supported_formats(self) -> set:
         raw = self.get_str(
             C.DEFAULT_SUPPORTED_FORMATS, C.DEFAULT_SUPPORTED_FORMATS_DEFAULT

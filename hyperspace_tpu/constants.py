@@ -201,6 +201,16 @@ SERVE_CACHE_ENABLED_DEFAULT = False
 SERVE_CACHE_MAX_BYTES = "hyperspace.serve.cache.maxBytes"
 SERVE_CACHE_MAX_BYTES_DEFAULT = 4 << 30  # 4 GiB
 
+# Range serve plane (executor._range_pruned_scan + indexes/zonemaps.py,
+# see docs/range-serve.md): zone-map pruning of index files and row
+# groups under range/Eq/In conjuncts, z-address range decomposition for
+# z-order relations, and the fused hs_range_mask residual kernel.
+# Superset-safe by construction (pruned-scan ≡ full-scan+mask,
+# differential-tested); the flag restores the unpruned path bit-
+# identically for A/B timing and as an escape hatch.
+SERVE_RANGEPRUNE_ENABLED = "hyperspace.serve.rangeprune.enabled"
+SERVE_RANGEPRUNE_ENABLED_DEFAULT = True
+
 # Pipelined serve path (execution/executor.py + join_exec.py, see
 # docs/serve-pipeline.md): on a co-bucketed join over clean index-scan
 # shapes, the two sides prepare concurrently, per-bucket parquet reads

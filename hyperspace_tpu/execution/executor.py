@@ -9,6 +9,7 @@ host fallback for expressions the device path does not cover.
 
 from __future__ import annotations
 
+from functools import lru_cache as _lru_cache
 from typing import Optional, Set
 
 import numpy as np
@@ -42,6 +43,7 @@ def _exec(plan: LogicalPlan, needed: Set[str], session) -> ColumnarBatch:
         return _exec_scan(plan, needed, session)
     if isinstance(plan, Filter):
         child = _bucket_pruned_scan(plan.child, plan.condition)
+        child = _range_pruned_scan(child, plan.condition, session)
         child_needed = set(needed) | E.references(plan.condition)
         if isinstance(child, Scan):
             cached = _cached_filter(child, plan.condition, child_needed, session)
@@ -611,7 +613,6 @@ def _bucket_pruned_scan(plan: LogicalPlan, cond: E.Expr) -> LogicalPlan:
     import dataclasses
     import itertools
 
-    from hyperspace_tpu.io.parquet import bucket_id_of_file
     from hyperspace_tpu.ops.hash import bucket_ids_np
 
     if not isinstance(plan, Scan) or plan.relation.bucket_spec is None:
@@ -659,15 +660,84 @@ def _bucket_pruned_scan(plan: LogicalPlan, cond: E.Expr) -> LogicalPlan:
         list(itertools.product(*rep_lists)), dtype=np.int64
     ).T.reshape(len(bucket_cols), -1)
     keep_buckets = set(bucket_ids_np(combos, num_buckets).tolist())
-    bucket_of = {f: bucket_id_of_file(f) for f in rel.files}
+    bucket_of = _bucket_ids_of_files(rel.files)
     kept = tuple(
         f
-        for f in rel.files
-        if bucket_of[f] is None or bucket_of[f] in keep_buckets
+        for f, b in zip(rel.files, bucket_of)
+        if b is None or b in keep_buckets
     )
     if len(kept) == len(rel.files):
         return plan
     return Scan(dataclasses.replace(rel, files=kept))
+
+
+@_lru_cache(maxsize=1024)
+def _bucket_ids_of_files(files) -> tuple:
+    """Per-file bucket ids for a relation's file tuple, memoized.
+
+    ``_bucket_pruned_scan`` used to re-run the filename regex over every
+    file on every query; a relation's file tuple is its content identity
+    for this purpose (bucket ids are a pure function of the immutable
+    file NAMES, and a refresh/optimize changes the file set and thereby
+    the key), so one parse per distinct file set suffices."""
+    from hyperspace_tpu.io.parquet import bucket_id_of_file
+
+    return tuple(bucket_id_of_file(f) for f in files)
+
+
+def _rangeprune_on(session) -> bool:
+    """Zone-map range pruning (``hyperspace.serve.rangeprune.enabled``,
+    default on). Unlike the serve pipeline this also applies to
+    sessionless execution — pruning is a pure read-side narrowing with no
+    thread fan-out of its own."""
+    from hyperspace_tpu import constants as C
+
+    if session is None:
+        return C.SERVE_RANGEPRUNE_ENABLED_DEFAULT
+    return session.conf.serve_rangeprune_enabled
+
+
+def _range_pruned_scan(
+    plan: LogicalPlan, cond: E.Expr, session
+) -> LogicalPlan:
+    """Zone-map pruning for index scans under a Filter: drop index files
+    (and narrow survivors to matching row groups) that the predicate's
+    range/Eq/In conjuncts cannot touch, per ``indexes/zonemaps.py``. The
+    executor-side payoff the reference gets from Spark's parquet min/max
+    pruning — generalized to whole-file drops, a vectorized pass over
+    all files at once, and z-address range decomposition for z-order
+    relations (docs/range-serve.md). Recurses through Project/Union so
+    the Hybrid-Scan index side prunes too; non-index relations (e.g. the
+    appended-files side) pass through untouched."""
+    if not _rangeprune_on(session):
+        return plan
+
+    from hyperspace_tpu.indexes import zonemaps
+
+    cache = _serve_cache(session)
+
+    def walk(node):
+        if isinstance(node, Scan):
+            if cache is not None and _cacheable_scan(node.relation):
+                # serve-server mode keeps FULL decoded files in RAM keyed
+                # by the complete file set, shared across predicates and
+                # narrowed by binary search — pruning a cacheable scan
+                # would only fragment that entry into per-predicate file
+                # subsets. Cold serves (cache off) and uncacheable index
+                # scans (e.g. hybrid delete compensation) still prune.
+                return node
+            return zonemaps.prune_scan_relation(node, cond, cache)
+        if isinstance(node, Project):
+            child = walk(node.child)
+            return node if child is node.child else Project(node.columns, child)
+        if isinstance(node, Union):
+            left, right = walk(node.left), walk(node.right)
+            if left is node.left and right is node.right:
+                return node
+            return Union(left, right)
+        return node
+
+    return walk(plan)
 
 
 def _pushable_literal(value, arrow_type):
@@ -1146,7 +1216,17 @@ def _filter_mask(
     )
     if batch.num_rows < min_rows:
         # host-resident batch below the device threshold: numpy beats the
-        # host->device->host round trip (see constants.py rationale)
+        # host->device->host round trip (see constants.py rationale).
+        # A pure range/Eq conjunction takes the fused single-pass mask
+        # (native hs_range_mask / numpy twin, ops/filter.py) instead of
+        # the per-conjunct interpreter chain — bit-identical output,
+        # gated with the rest of the range serve plane.
+        if _rangeprune_on(session):
+            from hyperspace_tpu.ops.filter import fused_range_mask
+
+            fused = fused_range_mask(cond, batch)
+            if fused is not None:
+                return fused
         return E.filter_mask(cond, batch)
     try:
         return device_filter_mask(cond, batch)
@@ -1174,7 +1254,20 @@ def _exec_scan(
             {c: pa.array([], type=rel.schema[c]) for c in cols}
         )
         return ColumnarBatch.from_arrow(empty)
-    table = pio.read_table(list(rel.files), read_cols, rel.fmt, filters=pushdown)
+    if rel.file_row_groups is not None:
+        # zone-map row-group narrowing (executor._range_pruned_scan):
+        # read only the surviving row groups; the residual mask the
+        # caller applies makes over-reading harmless and under-reading
+        # impossible (superset contract, indexes/zonemaps.py). Pyarrow
+        # pushdown filters don't compose with explicit row-group reads —
+        # the narrowing already did the row-group half of their job.
+        table = pio.read_table_row_groups(
+            list(rel.files), list(rel.file_row_groups), read_cols, rel.fmt
+        )
+    else:
+        table = pio.read_table(
+            list(rel.files), read_cols, rel.fmt, filters=pushdown
+        )
     batch = ColumnarBatch.from_arrow(table)
     if rel.excluded_file_ids is not None:
         lineage = batch.column(DATA_FILE_NAME_ID).values

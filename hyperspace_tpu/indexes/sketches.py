@@ -114,76 +114,81 @@ class MinMaxSketch(Sketch):
             f"MinMax_{self.column}__max": hi,
         }
 
-    def _probe_literal(self, lit):
-        """Literal as a python value comparable to the stored sketch cells
-        (temporal literals parse through the recorded source type)."""
+    def _arrow_type(self):
         if self.source_type is None:
-            return lit
-        import pyarrow as pa
-
+            return None
         from hyperspace_tpu.rules.rule_utils import parse_arrow_type
 
         try:
-            t = parse_arrow_type(self.source_type)
+            return parse_arrow_type(self.source_type)
         except (ValueError, HyperspaceException):
-            # unparseable recorded type: probe with the raw literal
-            return lit
-        if not pa.types.is_temporal(t):
-            return lit
-        # stored cells are python date/datetime (to_pylist); normalize the
-        # probe literal to the same domain
-        return E.normalize_temporal_literal(lit, t)
+            return None
+
+    def _cell_zones(self, table: pa.Table, t):
+        """The sketch table's min/max cells as a zone-map column
+        (``indexes/zonemaps.ColZones``) through the SHARED assembly
+        helper, memoized per table identity — ``translate_filter`` probes
+        once per conjunct against the same table, and the cell conversion
+        is the dominant per-call cost (one pyarrow round trip per
+        temporal cell)."""
+        cached = getattr(self, "_zone_cache", None)
+        if cached is not None and cached[0] is table:
+            return cached[1]
+        from hyperspace_tpu.indexes import zonemaps as zm
+
+        lo_cells = table.column(f"MinMax_{self.column}__min").to_pylist()
+        hi_cells = table.column(f"MinMax_{self.column}__max").to_pylist()
+        cells = [
+            "allnull" if lo is None and hi is None else (lo, hi)
+            for lo, hi in zip(lo_cells, hi_cells)
+        ]
+        cz = zm.column_zones(cells, t)
+        self._zone_cache = (table, cz)
+        return cz
 
     def convert_predicate(self, expr, table):
-        lo_name = f"MinMax_{self.column}__min"
-        if lo_name not in table.column_names:
+        """Keep-mask over sketch rows, evaluated for ALL files in one
+        vectorized pass through the zone-map overlap test
+        (``indexes/zonemaps``) — the interval extraction and literal
+        lowering are SHARED with the executor's ``_range_pruned_scan``,
+        so sketch pruning and zone-map pruning can never disagree on
+        what a literal means."""
+        from hyperspace_tpu.indexes import zonemaps as zm
+
+        if f"MinMax_{self.column}__min" not in table.column_names:
             return None
-        lo = np.asarray(table.column(lo_name).to_pylist(), dtype=object)
-        hi = np.asarray(
-            table.column(f"MinMax_{self.column}__max").to_pylist(), dtype=object
-        )
-        valid = np.array([x is not None for x in lo])
-
-        def cmp(op, lit):
-            lit = self._probe_literal(lit)
-            if lit is None:
-                raise TypeError("unrepresentable probe literal")
-            out = np.zeros(len(lo), dtype=bool)
-            for i in range(len(lo)):
-                if not valid[i]:
-                    continue  # all-null file can't match a non-null literal
-                out[i] = {
-                    "=": lo[i] <= lit <= hi[i],
-                    "<": lo[i] < lit,
-                    "<=": lo[i] <= lit,
-                    ">": hi[i] > lit,
-                    ">=": hi[i] >= lit,
-                }[op]
-            return out
-
+        t = self._arrow_type()
+        if t is None:
+            return None  # no recorded type: abstain (sound, and real
+            # indexes always record one at creation)
         if isinstance(expr, E.In):
-            if (
+            if not (
                 isinstance(expr.child, E.Col)
                 and expr.child.name.lower() == self.column.lower()
             ):
-                try:
-                    masks = [cmp("=", v) for v in expr.values if v is not None]
-                except TypeError:  # incomparable literal type
-                    return None
-                if not masks:
-                    return np.zeros(len(lo), dtype=bool)
-                return np.logical_or.reduce(masks)
-            return None
+                return None
+            cz = self._cell_zones(table, t)
+            masks = []
+            for v in expr.values:
+                if v is None:
+                    continue
+                iv = zm.interval_for("=", v, t)
+                if iv is None:
+                    return None  # incomparable literal type: abstain
+                masks.append(zm.zone_keep_mask(cz, iv))
+            if not masks:
+                return np.zeros(len(cz.has), dtype=bool)
+            return np.logical_or.reduce(masks)
         norm = _normalize_conjunct(expr)
         if norm is None:
             return None
         op, col, lit = norm
         if col.lower() != self.column.lower() or op == "!=":
             return None
-        try:
-            return cmp(op, lit)
-        except TypeError:  # incomparable literal type
-            return None
+        iv = zm.interval_for(op, lit, t)
+        if iv is None:
+            return None  # incomparable literal type: abstain
+        return zm.zone_keep_mask(self._cell_zones(table, t), iv)
 
 
 @register_sketch

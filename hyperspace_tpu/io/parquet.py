@@ -145,10 +145,18 @@ def read_table(
         # which needs the permissive per-file concat below).
         schemas = _file_schemas(paths)
         if all(s.equals(schemas[0]) for s in schemas[1:]):
+            # partitioning=None: these are EXPLICIT file lists — hive
+            # partition values are injected by io/scan.py, never inferred
+            # from directory names. The default "hive" inference read the
+            # index version dirs (v__=N) as a partition column and made
+            # every serve spanning two index versions (incremental
+            # refresh MERGE, optimize's ignored files) fail with a
+            # type-merge error.
             return pq.read_table(
                 list(paths),
                 columns=list(columns) if columns else None,
                 filters=filters,
+                partitioning=None,
             )
     tables = []
     for p in paths:
@@ -158,6 +166,7 @@ def read_table(
                     p,
                     columns=list(columns) if columns else None,
                     filters=filters,
+                    partitioning=None,
                 )
             )
         elif fmt == "csv":
@@ -198,6 +207,49 @@ def read_batch(
     paths: Sequence[str], columns: Optional[Sequence[str]] = None, fmt: str = "parquet"
 ) -> ColumnarBatch:
     return ColumnarBatch.from_arrow(read_table(paths, columns, fmt))
+
+
+def read_table_row_groups(
+    paths: Sequence[str],
+    row_groups: Sequence[Optional[Sequence[int]]],
+    columns: Optional[Sequence[str]] = None,
+    fmt: str = "parquet",
+) -> pa.Table:
+    """Row-group-granular read: per file, only the listed row groups (None
+    = the whole file), concatenated in ``paths`` order — the cold-read
+    half of zone-map pruning (``executor._range_pruned_scan``). Row order
+    within a file follows ascending row-group index, which is the file's
+    own row order, so a selection of ALL groups is bit-identical to
+    ``read_table``. Reads overlap on the shared scan pool
+    (``io/scan.scan_pool``) when more than one file needs opening;
+    parquet-like formats only (callers gate on fmt)."""
+    if fmt not in ("parquet", "delta", "iceberg"):
+        raise HyperspaceException(
+            f"Row-group reads require a parquet-like format, got {fmt!r}"
+        )
+    cols = list(columns) if columns else None
+
+    def read_one(path, groups):
+        pf = pq.ParquetFile(path)
+        if groups is None:
+            return pf.read(columns=cols)
+        if len(groups) == 0:
+            return pf.schema_arrow.empty_table().select(
+                cols if cols is not None else pf.schema_arrow.names
+            )
+        return pf.read_row_groups(list(groups), columns=cols)
+
+    pairs = list(zip(paths, row_groups))
+    if len(pairs) <= 1:
+        tables = [read_one(p, g) for p, g in pairs]
+    else:
+        from hyperspace_tpu.io.scan import scan_pool
+
+        futs = [scan_pool().submit(read_one, p, g) for p, g in pairs]
+        tables = [f.result() for f in futs]
+    if not tables:
+        raise HyperspaceException("No files to read")
+    return pa.concat_tables(tables, promote_options="permissive")
 
 
 def list_format_files(root: str, fmt: str = "parquet") -> List[str]:
@@ -439,5 +491,14 @@ def write_bucket_files(
 
 
 def write_table(path: str, table: pa.Table) -> None:
+    # Same 64k row groups as the bucket files: z-order data files (and any
+    # other index payload written through here) get row-group min/max
+    # statistics narrow enough for the serve-side zone-map pruning
+    # (indexes/zonemaps.py) to drop most groups under a range predicate.
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    pq.write_table(table, path, use_dictionary=_dictionary_columns(table))
+    pq.write_table(
+        table,
+        path,
+        row_group_size=INDEX_ROW_GROUP_SIZE,
+        use_dictionary=_dictionary_columns(table),
+    )

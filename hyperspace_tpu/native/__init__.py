@@ -70,6 +70,10 @@ KERNEL_TWINS = {
     ),
     "hs_gather_i64": ("gather_i64", "numpy.take"),
     "hs_gather_f64": ("gather_f64", "numpy.take"),
+    "hs_range_mask": (
+        "range_mask_u8",
+        "hyperspace_tpu.ops.filter.range_mask_numpy",
+    ),
 }
 
 
@@ -337,6 +341,25 @@ def load(wait: bool = True):
                 _i64p,
                 _i64p,
                 ctypes.c_int64,
+                ctypes.c_int32,
+            ]
+            _u8p = ctypes.POINTER(ctypes.c_uint8)
+            lib.hs_range_mask.restype = ctypes.c_int
+            lib.hs_range_mask.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_void_p),
+                _u8p,
+                _i64p,
+                _i64p,
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_double),
+                _u8p,
+                _u8p,
+                _u8p,
+                _u8p,
+                ctypes.c_int32,
+                ctypes.c_int64,
+                _u8p,
                 ctypes.c_int32,
             ]
             _f64p = ctypes.POINTER(ctypes.c_double)
@@ -636,6 +659,73 @@ def gather_f64(
     payloads survive). None on unavailability or out-of-range indices."""
     values = np.ascontiguousarray(values, dtype=np.float64)
     return _gather_64(values, idx)
+
+
+def range_mask_u8(
+    cols,
+    valids,
+    is_f64,
+    lo_i,
+    hi_i,
+    lo_f,
+    hi_f,
+    flags,
+    n: int,
+) -> Optional[np.ndarray]:
+    """Fused range mask over ``k`` terms: per term a contiguous 8-byte
+    column array (int64 view or float64), optional bool validity, and
+    lo/hi bounds with ``flags`` = (has_lo, has_hi, lo_strict, hi_strict)
+    — the single-pass twin of ``ops/filter.range_mask_numpy`` (the
+    registered KERNEL_TWINS reference). Returns a bool mask, or None when
+    the native kernel is unavailable (caller runs the numpy twin)."""
+    lib = load(wait=False)
+    if lib is None:
+        return None
+    k = len(cols)
+    if k == 0 or n == 0:
+        return np.ones(n, dtype=bool)
+    col_ptrs = (ctypes.c_void_p * k)(*(c.ctypes.data for c in cols))
+    valid_arrs = [
+        None if v is None else np.ascontiguousarray(v, dtype=np.uint8)
+        for v in valids
+    ]
+    valid_ptrs = (ctypes.c_void_p * k)(
+        *(None if v is None else v.ctypes.data for v in valid_arrs)
+    )
+    u8 = lambda xs: np.asarray([1 if x else 0 for x in xs], dtype=np.uint8)
+    is_f64_a = u8(is_f64)
+    has_lo = u8(f[0] for f in flags)
+    has_hi = u8(f[1] for f in flags)
+    lo_strict = u8(f[2] for f in flags)
+    hi_strict = u8(f[3] for f in flags)
+    lo_i_a = np.asarray(lo_i, dtype=np.int64)
+    hi_i_a = np.asarray(hi_i, dtype=np.int64)
+    lo_f_a = np.asarray(lo_f, dtype=np.float64)
+    hi_f_a = np.asarray(hi_f, dtype=np.float64)
+    out = np.empty(n, dtype=np.uint8)
+    _u8p = ctypes.POINTER(ctypes.c_uint8)
+    _i64p = ctypes.POINTER(ctypes.c_int64)
+    _f64p = ctypes.POINTER(ctypes.c_double)
+    rc = lib.hs_range_mask(
+        col_ptrs,
+        valid_ptrs,
+        is_f64_a.ctypes.data_as(_u8p),
+        lo_i_a.ctypes.data_as(_i64p),
+        hi_i_a.ctypes.data_as(_i64p),
+        lo_f_a.ctypes.data_as(_f64p),
+        hi_f_a.ctypes.data_as(_f64p),
+        has_lo.ctypes.data_as(_u8p),
+        has_hi.ctypes.data_as(_u8p),
+        lo_strict.ctypes.data_as(_u8p),
+        hi_strict.ctypes.data_as(_u8p),
+        ctypes.c_int32(k),
+        ctypes.c_int64(n),
+        out.ctypes.data_as(_u8p),
+        ctypes.c_int32(_n_threads(n)),
+    )
+    if rc != 0:
+        return None
+    return out.view(np.bool_)
 
 
 def bucket_ids_i64(

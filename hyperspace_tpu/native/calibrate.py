@@ -47,7 +47,7 @@ import numpy as np
 _log = logging.getLogger("hyperspace_tpu.native.calibrate")
 
 # Bump when the probe methodology changes; stale cache files re-probe.
-_PROBE_VERSION = 3
+_PROBE_VERSION = 4
 
 # Effectively-infinite row count: "this engine never loses on this
 # machine" (e.g. host vs device on a CPU backend, or a tunnel-attached
@@ -76,6 +76,7 @@ class Thresholds:
     native_partition_min_rows: int = 0
     native_expand_min_rows: int = 0
     native_gather_min_rows: int = 0
+    native_range_mask_min_rows: int = 0
     source: str = "defaults"
 
 
@@ -262,6 +263,49 @@ def _probe_native_gather_min() -> int:
     return _NATIVE_PROBE_SIZES[-1] * 2
 
 
+def _probe_native_range_mask_min() -> int:
+    """Crossover for the fused range-mask kernel vs its numpy twin,
+    probed at the ROW count with a serve-shaped predicate (two int64
+    bound terms + one float64 term, ~10% selectivity)."""
+    from hyperspace_tpu import native
+
+    if _native_lib_or_busy() is None:
+        return 0
+    rng = np.random.default_rng(48)
+    for n in _NATIVE_PROBE_SIZES:
+        a = rng.integers(0, 1 << 20, n, dtype=np.int64)
+        b = rng.integers(0, 1 << 20, n, dtype=np.int64)
+        c = rng.normal(0.0, 1.0, n)
+        cols = [a, b, c.view(np.float64)]
+        valids = [None, None, None]
+        is_f64 = [False, False, True]
+        lo_i = [1000, 0, 0]
+        hi_i = [110000, 200000, 0]
+        lo_f = [0.0, 0.0, -1.0]
+        hi_f = [0.0, 0.0, 1.0]
+        flags = [
+            (True, True, False, True),
+            (True, True, False, False),
+            (True, True, True, False),
+        ]
+        t_native = _time_best(
+            lambda: native.range_mask_u8(
+                cols, valids, is_f64, lo_i, hi_i, lo_f, hi_f, flags, n
+            )
+        )
+        t_numpy = _time_best(
+            lambda: (a >= 1000)
+            & (a < 110000)
+            & (b >= 0)
+            & (b <= 200000)
+            & (c > -1.0)
+            & (c <= 1.0)
+        )
+        if t_native < t_numpy:
+            return n
+    return _NATIVE_PROBE_SIZES[-1] * 2
+
+
 def _probe_host_max(op: str, platform: str) -> int:
     """Smallest size where the device beats the host for ``op`` ("sort" |
     "hash"), extrapolated monotonic; _NEVER when the host wins at every
@@ -335,6 +379,7 @@ def _probe() -> Thresholds:
         native_partition_min_rows=_probe_native_partition_min(),
         native_expand_min_rows=_probe_native_expand_min(),
         native_gather_min_rows=_probe_native_gather_min(),
+        native_range_mask_min_rows=_probe_native_range_mask_min(),
         source="calibrated",
     )
     _log.info(
@@ -363,6 +408,9 @@ def _load_cache() -> Optional[Thresholds]:
             native_partition_min_rows=int(t["native_partition_min_rows"]),
             native_expand_min_rows=int(t["native_expand_min_rows"]),
             native_gather_min_rows=int(t["native_gather_min_rows"]),
+            native_range_mask_min_rows=int(
+                t["native_range_mask_min_rows"]
+            ),
             source="calibrated",
         )
     except (KeyError, TypeError, ValueError):
@@ -399,6 +447,7 @@ def _store_cache(t: Thresholds) -> None:
                             "native_partition_min_rows",
                             "native_expand_min_rows",
                             "native_gather_min_rows",
+                            "native_range_mask_min_rows",
                         )
                     },
                 },

@@ -433,6 +433,68 @@ int hs_gather_f64(const double* src, int64_t n_src, const int64_t* idx,
                   reinterpret_cast<uint64_t*>(out), n_threads);
 }
 
+// Fused range mask: out[r] = 1 iff row r passes EVERY term's bound
+// checks and validity. A term is one numeric range/Eq conjunct of the
+// serve-path residual predicate (ops/filter.py lower_range_terms): an
+// int64 or float64 column, optional lo/hi bounds with strictness, and
+// an optional validity byte mask. The numpy twin
+// (ops/filter.range_mask_numpy) makes ~2 full-array passes per term
+// plus the AND passes; this is one pass over the rows total, threaded
+// by contiguous row chunks. Float compares are IEEE (NaN fails every
+// bound — identical to the engine's mask semantics). Returns 0 on
+// success, 1 on bad arguments, 2 on resource exhaustion.
+int hs_range_mask(const void** cols, const uint8_t** valids,
+                  const uint8_t* is_f64, const int64_t* lo_i,
+                  const int64_t* hi_i, const double* lo_f,
+                  const double* hi_f, const uint8_t* has_lo,
+                  const uint8_t* has_hi, const uint8_t* lo_strict,
+                  const uint8_t* hi_strict, int32_t k, int64_t n,
+                  uint8_t* out, int32_t n_threads) {
+  if (n < 0 || k <= 0 || (n > 0 && (cols == nullptr || out == nullptr)))
+    return 1;
+  for (int32_t t = 0; t < k; ++t)
+    if (cols[t] == nullptr) return 1;
+  if (n == 0) return 0;
+  if (n_threads < 1) n_threads = 1;
+  const int T = static_cast<int>(
+      std::min<int64_t>(n < (1 << 16) ? 1 : n_threads, n));
+  try {
+    const int64_t chunk = (n + T - 1) / T;
+    auto work = [&](int th) {
+      int64_t lo = th * chunk, hi = std::min<int64_t>(n, lo + chunk);
+      for (int64_t r = lo; r < hi; ++r) {
+        uint8_t ok = 1;
+        for (int32_t t = 0; t < k && ok; ++t) {
+          if (valids != nullptr && valids[t] != nullptr && !valids[t][r]) {
+            ok = 0;
+            break;
+          }
+          if (is_f64[t]) {
+            const double v = static_cast<const double*>(cols[t])[r];
+            if (has_lo[t] && !(lo_strict[t] ? v > lo_f[t] : v >= lo_f[t]))
+              ok = 0;
+            else if (has_hi[t] &&
+                     !(hi_strict[t] ? v < hi_f[t] : v <= hi_f[t]))
+              ok = 0;
+          } else {
+            const int64_t v = static_cast<const int64_t*>(cols[t])[r];
+            if (has_lo[t] && !(lo_strict[t] ? v > lo_i[t] : v >= lo_i[t]))
+              ok = 0;
+            else if (has_hi[t] &&
+                     !(hi_strict[t] ? v < hi_i[t] : v <= hi_i[t]))
+              ok = 0;
+          }
+        }
+        out[r] = ok;
+      }
+    };
+    run_on_threads(T, work);
+  } catch (...) {
+    return 2;
+  }
+  return 0;
+}
+
 // MurmurHash3-32 bucket ids over k int64 key columns, one pass per row.
 // Bit-exact twin of ops/hash.bucket_ids_host (numpy) and the XLA kernel:
 // each key rep contributes its lo then hi uint32 word to the block

@@ -21,6 +21,7 @@ different file does not recompile).
 from __future__ import annotations
 
 import functools
+import math
 from typing import Any, List, Optional, Tuple
 
 import jax
@@ -258,6 +259,251 @@ def _apply_cmp(op, a, b):
 def _run(spec, n, args: Tuple):
     vals, valid = _eval_spec(spec, list(args), n)
     return vals & valid
+
+
+# ---------------------------------------------------------------------------
+# Fused range mask (hs_range_mask; docs/range-serve.md)
+# ---------------------------------------------------------------------------
+#
+# A conjunction of numeric col-vs-lit range/Eq conjuncts — the residual
+# mask of the range serve plane after zone-map pruning — evaluates on the
+# host as one fused compare-AND pass instead of ~2 numpy passes per
+# conjunct plus the Kleene bookkeeping of the expression interpreter.
+# Final-mask equivalence is exact: for a conjunction, the filter's final
+# mask equals the AND of each conjunct's (values & valid) mask, and each
+# supported conjunct's mask is a pair of bound comparisons ANDed with the
+# column's validity. Anything outside that shape (strings, IN, OR, NOT,
+# IS NULL, !=, unloggable literals) falls back to the interpreter
+# unchanged.
+
+# At or above this ROW count the fused mask dispatches to the native
+# kernel; below it the numpy twin's vectorized passes win. FALLBACK
+# DEFAULT: the effective threshold comes from the per-machine calibration
+# probe (native/calibrate.py); an explicit module-attribute override wins.
+_NATIVE_RANGE_MASK_MIN_ROWS_DEFAULT = 1 << 15
+_NATIVE_RANGE_MASK_MIN_ROWS = _NATIVE_RANGE_MASK_MIN_ROWS_DEFAULT
+
+
+def _native_range_mask_min_rows() -> int:
+    if _NATIVE_RANGE_MASK_MIN_ROWS != _NATIVE_RANGE_MASK_MIN_ROWS_DEFAULT:
+        return _NATIVE_RANGE_MASK_MIN_ROWS  # explicit (test/ops) override
+    from hyperspace_tpu.native import calibrate
+
+    return (
+        calibrate.thresholds().native_range_mask_min_rows
+        or _NATIVE_RANGE_MASK_MIN_ROWS
+    )
+
+
+def lower_range_terms(expr: E.Expr, batch):
+    """[(name, lo, lo_strict, hi, hi_strict, empty)] when EVERY conjunct
+    is a numeric col-vs-lit comparison in =,<,<=,>,>= with a literal the
+    engine can compare (temporal literals lowered with the same op-aware
+    snapping the interpreter uses), else None. ``empty`` marks a conjunct
+    whose lowered literal can never match (all-False mask)."""
+    terms = []
+    for cj in E.split_conjuncts(expr):
+        norm = E.normalize_comparison(cj)
+        if norm is None:
+            return None
+        op, name, lit = norm
+        if op == "!=":
+            return None
+        if name not in batch.columns:
+            return None
+        col = batch.columns[name]
+        if col.kind != "numeric":
+            return None
+        kind = col.values.dtype.kind
+        if kind not in "if":
+            return None  # uint/bool columns keep the interpreter path
+        lv = E.lower_literal(lit, col.arrow_type, op)
+        if lv is None:
+            terms.append((name, None, False, None, False, True))
+            continue
+        if isinstance(lv, (np.integer, np.floating)):
+            pass  # engine-lowered scalar, compares exactly
+        elif isinstance(lv, bool):
+            lv = int(lv)
+        elif isinstance(lv, int):
+            if kind == "i" and not (-(2**63) <= lv < 2**63):
+                return None  # out-of-range python int: interpreter decides
+        elif not isinstance(lv, float):
+            return None  # non-numeric literal on a numeric column
+        if op == "=":
+            terms.append((name, lv, False, lv, False, False))
+        elif op == "<":
+            terms.append((name, None, False, lv, True, False))
+        elif op == "<=":
+            terms.append((name, None, False, lv, False, False))
+        elif op == ">":
+            terms.append((name, lv, True, None, False, False))
+        else:  # >=
+            terms.append((name, lv, False, None, False, False))
+    if not terms or len(terms) > 16:
+        return None
+    return terms
+
+
+def range_mask_numpy(batch, terms) -> np.ndarray:
+    """The numpy twin of ``hs_range_mask``: per term the SAME comparison
+    expressions the host interpreter runs (so dtype promotion, NaN and
+    uint semantics can never diverge), ANDed into one mask."""
+    n = batch.num_rows
+    out = np.ones(n, dtype=bool)
+    with np.errstate(invalid="ignore"):
+        for name, lo, lo_strict, hi, hi_strict, empty in terms:
+            col = batch.columns[name]
+            if empty:
+                vals = np.zeros(n, dtype=bool)
+            else:
+                v = col.values
+                vals = np.ones(n, dtype=bool)
+                if lo is not None:
+                    vals &= (v > lo) if lo_strict else (v >= lo)
+                if hi is not None:
+                    vals &= (v < hi) if hi_strict else (v <= hi)
+            if col.validity is not None:
+                vals = vals & col.validity
+            out &= vals
+    return out
+
+
+def _native_range_mask(batch, terms) -> Optional[np.ndarray]:
+    """Native dispatch of the fused mask: contiguous 8-byte numeric
+    columns with exactly-representable bounds only — anything else
+    returns None and the numpy twin runs. Integer bounds given as floats
+    tighten to the enclosing integers (exact on integer domains)."""
+    cols = []
+    valids = []
+    is_f64 = []
+    lo_i = []
+    hi_i = []
+    lo_f = []
+    hi_f = []
+    flags = []  # (has_lo, has_hi, lo_strict, hi_strict)
+    n = batch.num_rows
+    for name, lo, lo_strict, hi, hi_strict, empty in terms:
+        col = batch.columns[name]
+        if empty:
+            return np.zeros(n, dtype=bool)
+        v = col.values
+        if v.ndim != 1 or v.dtype.itemsize != 8 or not v.flags.c_contiguous:
+            return None
+        f64 = v.dtype.kind == "f"
+        if f64 and v.dtype != np.float64:
+            return None
+        if not f64 and v.dtype.kind not in "iMm":
+            return None
+
+        def int_bound(b, is_lo):
+            """(bound, strict) in exact int64, or None to bail."""
+            nonlocal_strict = lo_strict if is_lo else hi_strict
+            if isinstance(b, (np.integer,)):
+                b = int(b)
+            if isinstance(b, float) or isinstance(b, np.floating):
+                fb = float(b)
+                if math.isnan(fb):
+                    return "never"
+                if math.isinf(fb):
+                    # -inf lo / +inf hi: unbounded; +inf lo / -inf hi:
+                    # nothing can pass
+                    if (fb > 0) == is_lo:
+                        return "never"
+                    return "unbounded"
+                if abs(fb) >= 2.0**53:
+                    # the interpreter/twin compare int64 values against a
+                    # FLOAT bound by promoting the column to float64; an
+                    # exact int64 compare diverges for values beyond
+                    # 2^53, so the numpy twin must decide these
+                    return None
+                if fb != int(fb):
+                    # v > 2.5 == v >= 3; v < 2.5 == v <= 2 on integers
+                    return (
+                        (math.ceil(fb), False)
+                        if is_lo
+                        else (math.floor(fb), False)
+                    )
+                b = int(fb)
+            if not isinstance(b, int):
+                return None
+            if not (-(2**63) <= b < 2**63):
+                return None
+            return (b, nonlocal_strict)
+
+        if f64:
+            def f_bound(b):
+                if isinstance(b, (int, np.integer)) and not isinstance(b, bool):
+                    fb = np.float64(b)
+                    if int(fb) != int(b):
+                        return None  # not exactly representable: bail
+                    return float(fb)
+                return float(b)
+
+            flo = f_bound(lo) if lo is not None else None
+            fhi = f_bound(hi) if hi is not None else None
+            if (lo is not None and flo is None) or (
+                hi is not None and fhi is None
+            ):
+                return None
+            lo_f.append(flo if flo is not None else 0.0)
+            hi_f.append(fhi if fhi is not None else 0.0)
+            lo_i.append(0)
+            hi_i.append(0)
+            flags.append(
+                (lo is not None, hi is not None, lo_strict, hi_strict)
+            )
+        else:
+            ilo = int_bound(lo, True) if lo is not None else "unbounded"
+            ihi = int_bound(hi, False) if hi is not None else "unbounded"
+            if ilo is None or ihi is None:
+                return None
+            if ilo == "never" or ihi == "never":
+                return np.zeros(n, dtype=bool)
+            has_lo = ilo != "unbounded"
+            has_hi = ihi != "unbounded"
+            lo_i.append(ilo[0] if has_lo else 0)
+            hi_i.append(ihi[0] if has_hi else 0)
+            lo_f.append(0.0)
+            hi_f.append(0.0)
+            flags.append(
+                (
+                    has_lo,
+                    has_hi,
+                    ilo[1] if has_lo else False,
+                    ihi[1] if has_hi else False,
+                )
+            )
+        is_f64.append(f64)
+        cols.append(v if f64 else v.view(np.int64))
+        valids.append(col.validity)
+    from hyperspace_tpu import native
+
+    return native.range_mask_u8(
+        cols, valids, is_f64, lo_i, hi_i, lo_f, hi_f, flags, n
+    )
+
+
+def range_mask(batch, terms) -> np.ndarray:
+    """Host dispatch of the fused range mask: the native single-pass
+    kernel at or above the calibrated row crossover, else the numpy twin
+    — identical output either way."""
+    if batch.num_rows >= _native_range_mask_min_rows():
+        out = _native_range_mask(batch, terms)
+        if out is not None:
+            return out
+    return range_mask_numpy(batch, terms)
+
+
+def fused_range_mask(expr: E.Expr, batch) -> Optional[np.ndarray]:
+    """The executor's entry: the fused mask when the whole predicate
+    lowers to numeric range terms, else None (interpreter path)."""
+    if batch.num_rows == 0:
+        return None
+    terms = lower_range_terms(expr, batch)
+    if terms is None:
+        return None
+    return range_mask(batch, terms)
 
 
 def device_filter_mask(expr: E.Expr, batch) -> np.ndarray:

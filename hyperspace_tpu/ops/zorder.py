@@ -198,6 +198,162 @@ def _dict_encode(col, sorted_global: List[str]) -> np.ndarray:
     return np.where(col.codes < 0, np.uint64(0), enc)
 
 
+# ---------------------------------------------------------------------------
+# Z-address range decomposition (serve-side pruning; docs/range-serve.md)
+# ---------------------------------------------------------------------------
+#
+# A z-laid-out index file is a contiguous run of the z-sorted order, so its
+# rows span a narrow interval of z-addresses even when each COLUMN's
+# per-file min/max is wide. Pruning therefore works in z-space: the query
+# box (per-column word intervals under the file set's frozen encoder spec)
+# decomposes into a small set of z-address keep-ranges, and a file/row
+# group whose captured z-span misses every range cannot hold a matching
+# row. Per-column min/max alone cannot reconstruct the spans (the interval
+# [z(mins), z(maxs)] always intersects the box whenever every column
+# overlaps it), which is why capture (indexes/zonemaps.py) records the
+# actual spans at build time and the serve path falls back to per-column
+# pruning when they are absent.
+
+
+def order_u64_scalar(value, kind: str) -> int:
+    """Order-preserving uint64 of ONE engine-domain value — the scalar
+    twin of :func:`order_u64_np` (same branches, same bit tricks) for
+    encoding query-box bounds. ``kind`` is the numpy dtype kind of the
+    column's storage ("f"/"b"/"u"/else-int). ``value`` must already be
+    in the column's storage domain — callers convert non-integral or
+    out-of-range bounds outward (floor/ceil, ±inf → unbounded side)
+    before encoding."""
+    if kind == "f":
+        bits = int(np.float64(value).view(np.uint64))
+        if bits >> 63:
+            return (~bits) & 0xFFFFFFFFFFFFFFFF
+        return bits | (1 << 63)
+    if kind == "b":
+        return int(bool(value)) + 1
+    v = int(value)
+    if kind == "u":
+        return v & 0xFFFFFFFFFFFFFFFF
+    return (v ^ -(1 << 63)) & 0xFFFFFFFFFFFFFFFF
+
+
+def spec_word_bounds(spec, enc_lo: int, enc_hi: int, bits: int):
+    """[word_lo, word_hi] of an encoded-value interval under one frozen
+    spec — the scalar twin of :meth:`ZOrderEncoder._words`, rounded
+    OUTWARD (floor the low end, ceil the high end) so the word box is a
+    superset of the value box. Only "range" and "dict" specs appear in
+    captured zone-map metadata; quantile specs abstain (None)."""
+    top = (1 << bits) - 1
+    if spec[0] == "dict":
+        mn, mx = 0, len(spec[1])
+    elif spec[0] == "range":
+        mn, mx = int(spec[1]), int(spec[2])
+    else:
+        return None
+    rng = mx - mn
+    if rng <= 0:
+        return 0, top
+    scale = ((2.0**bits) - 1) / float(rng)
+
+    def word(enc, up):
+        off = float(max(min(enc, mx), mn) - mn) * scale
+        w = int(np.ceil(off)) if up else int(np.floor(off))
+        return max(0, min(top, w))
+
+    return word(enc_lo, False), word(enc_hi, True)
+
+
+def z_box_ranges(word_lo, word_hi, bits: int, max_ranges: int = 64):
+    """Decompose a per-column word box into z-address keep-ranges.
+
+    Returns a sorted list of inclusive ``(z_lo, z_hi)`` python-int ranges
+    (in k*bits-bit z-space, MSB = column 0's top bit — the
+    :func:`_interleave` layout) whose union COVERS every z-address inside
+    the box; a bounded recursion emits partially-covered cells whole when
+    the budget runs out, so the union may over-cover (superset-safe) but
+    never under-covers. Standard prefix-tree (BIGMIN-family) walk: a cell
+    disjoint from the box in any column is dropped, a fully-contained
+    cell emits its whole z-interval, anything else splits on the next
+    z-bit."""
+    k = len(word_lo)
+    total = k * bits
+    out = []
+    budget = [max(4, int(max_ranges)) * 4]
+
+    def rec(depth, zpref, col_pref):
+        nfixed = [depth // k + (1 if j < depth % k else 0) for j in range(k)]
+        for j in range(k):
+            free = bits - nfixed[j]
+            clo = col_pref[j] << free
+            chi = clo + (1 << free) - 1
+            if chi < word_lo[j] or clo > word_hi[j]:
+                return
+        inside = True
+        for j in range(k):
+            free = bits - nfixed[j]
+            clo = col_pref[j] << free
+            chi = clo + (1 << free) - 1
+            if clo < word_lo[j] or chi > word_hi[j]:
+                inside = False
+                break
+        span = total - depth
+        if inside or depth == total or budget[0] <= 0:
+            lo = zpref << span
+            out.append((lo, lo + (1 << span) - 1))
+            return
+        budget[0] -= 1
+        j = depth % k
+        for b in (0, 1):
+            child = list(col_pref)
+            child[j] = (col_pref[j] << 1) | b
+            rec(depth + 1, (zpref << 1) | b, child)
+
+    rec(0, 0, [0] * k)
+    out.sort()
+    merged = []
+    for lo, hi in out:
+        if merged and lo <= merged[-1][1] + 1:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], hi))
+        else:
+            merged.append((lo, hi))
+    return merged
+
+
+def planes_z_minmax(planes: np.ndarray, start: int, end: int):
+    """(z_lo, z_hi) python ints of rows [start, end) of ``planes``
+    ([nplanes, n] uint32, most-significant plane first), in PACKED
+    (32*nplanes-bit) z-space — the capture-side reader of per-row-group
+    z-spans. None for an empty slice. Single-plane layouts (k*bits ≤ 32,
+    the common 1-2 column case) reduce to a vectorized min/max; wider
+    addresses pay one lexsort of the slice."""
+    sub = planes[:, start:end]
+    n = sub.shape[1]
+    if n == 0:
+        return None
+
+    def pack(col) -> int:
+        z = 0
+        for w in col:
+            z = (z << 32) | int(w)
+        return z
+
+    if sub.shape[0] == 1:
+        return int(sub[0].min()), int(sub[0].max())
+    order = np.lexsort(sub[::-1])
+    return pack(sub[:, order[0]]), pack(sub[:, order[-1]])
+
+
+def pack_box_ranges(ranges, bits: int, k: int, nplanes: int):
+    """Shift keep-ranges from k*bits-bit z-space into the PACKED
+    32*nplanes-bit space :func:`planes_z_minmax` reports spans in (the
+    last plane's low bits are zero padding)."""
+    pad = 32 * nplanes - k * bits
+    if pad <= 0:
+        return list(ranges)
+    return [
+        ((lo << pad), ((hi << pad) | ((1 << pad) - 1))) for lo, hi in ranges
+    ]
+
+
 def z_order_permutation(
     columns: List,
     bits: int = 16,

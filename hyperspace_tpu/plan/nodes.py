@@ -93,6 +93,15 @@ class Relation:
     # partition column values that are NOT stored in the data file and must
     # be injected as constants at scan time: (path, ((col, str_value),...))
     file_partition_values: Tuple[Tuple[str, Tuple[Tuple[str, Optional[str]], ...]], ...] = ()
+    # query-time row-group pruning (zone maps, executor._range_pruned_scan):
+    # aligned with ``files``; per file either None (read every row group) or
+    # the ascending row-group indices to read. None for the whole field
+    # means no narrowing anywhere. Set ONLY by the range-pruning pass on a
+    # Filter's direct scan — the selection is query-shaped state and must
+    # never leak into fingerprint-keyed caches of whole-file data (the
+    # serve cache reads full files regardless, so its entries stay a
+    # superset; see executor._scan_cache_entry).
+    file_row_groups: Optional[Tuple[Optional[Tuple[int, ...]], ...]] = None
 
     @property
     def schema(self) -> Dict[str, pa.DataType]:

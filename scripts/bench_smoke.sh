@@ -14,8 +14,20 @@ if [ "$ROWS" -gt 100000 ]; then
     echo "bench_smoke: capping rows at 100000 (got $ROWS)" >&2
     ROWS=100000
 fi
-JAX_PLATFORMS=cpu \
+OUT=$(JAX_PLATFORMS=cpu \
 HS_BENCH_ROWS="$ROWS" \
 HS_BENCH_REPS="${HS_BENCH_REPS:-2}" \
 HS_BENCH_LADDER="$ROWS" \
-exec python bench.py
+python bench.py)
+echo "$OUT"
+# the pruned filter path must actually have run: the z-order row's
+# zone-map telemetry is part of the bench JSON contract
+echo "$OUT" | python -c '
+import json, sys
+d = json.loads(sys.stdin.read())
+zp = d["zorder_prune"]
+assert zp["row_groups_total"] > 0, "rangeprune telemetry missing"
+assert "zonemap_hit_rate" in zp, zp
+assert "zorder_range_pruneoff_p50_ms" in d, "prune A/B leg missing"
+print("bench_smoke: rangeprune telemetry ok:", zp, file=sys.stderr)
+'

@@ -925,3 +925,158 @@ class TestDispatch:
         )
         path = native._cache_path()
         assert str(tmp_path) in path
+
+
+class TestRangeMaskParity:
+    """hs_range_mask vs ops/filter.range_mask_numpy (the registered
+    KERNEL_TWINS reference) — the fused compare-AND of the range serve
+    plane must match the per-conjunct numpy passes bit for bit,
+    including NaN rows (fail every bound), validity masks, strict vs
+    closed bounds and int64 extremes."""
+
+    @staticmethod
+    def _batch(n, seed=51, with_nulls=True):
+        import pyarrow as pa
+
+        from hyperspace_tpu.io.columnar import ColumnarBatch
+
+        rng = np.random.default_rng(seed)
+        f = rng.normal(0, 1, n)
+        f[::13] = np.nan
+        f[1::13] = -0.0
+        cols = {
+            "i": pa.array(
+                rng.integers(-(2**62), 2**62, n, dtype=np.int64)
+            ),
+            "f": pa.array(f),
+        }
+        if with_nulls:
+            cols["m"] = pa.array(
+                [None if j % 7 == 0 else int(j) for j in range(n)],
+                type=pa.int64(),
+            )
+        return ColumnarBatch.from_arrow(pa.table(cols))
+
+    def _check(self, batch, terms):
+        from hyperspace_tpu.ops.filter import range_mask_numpy
+
+        ref = range_mask_numpy(batch, terms)
+        cols, valids, is_f64, lo_i, hi_i, lo_f, hi_f, flags = (
+            [], [], [], [], [], [], [], []
+        )
+        for name, lo, los, hi, his, empty in terms:
+            assert not empty
+            col = batch.columns[name]
+            f64 = col.values.dtype.kind == "f"
+            is_f64.append(f64)
+            cols.append(col.values if f64 else col.values.view(np.int64))
+            valids.append(col.validity)
+            if f64:
+                lo_f.append(float(lo) if lo is not None else 0.0)
+                hi_f.append(float(hi) if hi is not None else 0.0)
+                lo_i.append(0)
+                hi_i.append(0)
+            else:
+                lo_i.append(int(lo) if lo is not None else 0)
+                hi_i.append(int(hi) if hi is not None else 0)
+                lo_f.append(0.0)
+                hi_f.append(0.0)
+            flags.append((lo is not None, hi is not None, los, his))
+        got = native.range_mask_u8(
+            cols, valids, is_f64, lo_i, hi_i, lo_f, hi_f, flags,
+            batch.num_rows,
+        )
+        assert got is not None
+        np.testing.assert_array_equal(got, ref)
+
+    def test_int_bounds(self):
+        batch = self._batch(100_000)
+        self._check(batch, [("i", -(2**61), False, 2**61, True, False)])
+
+    def test_float_bounds_nan_fails(self):
+        batch = self._batch(100_000)
+        self._check(batch, [("f", -0.5, True, 0.5, False, False)])
+
+    def test_validity_and_multi_term(self):
+        batch = self._batch(100_000)
+        self._check(
+            batch,
+            [
+                ("i", 0, False, None, False, False),
+                ("f", None, False, 1.0, True, False),
+                ("m", 100, True, 90_000, False, False),
+            ],
+        )
+
+    def test_eq_as_closed_pair(self):
+        batch = self._batch(50_000)
+        v = int(batch.columns["i"].values[17])
+        self._check(batch, [("i", v, False, v, False, False)])
+
+    def test_int64_extremes(self):
+        batch = self._batch(50_000)
+        self._check(
+            batch,
+            [("i", -(2**63), False, 2**63 - 1, False, False)],
+        )
+
+    def test_float_bound_beyond_2_53_on_int64_matches_interpreter(self):
+        """A float bound >= 2^53 on an int64 column must NOT take the
+        exact-int native compare: the interpreter promotes the column to
+        float64 (2^62+1 == 2^62 there), so the dispatch bails to the
+        numpy twin, which replicates that promotion exactly."""
+        import pyarrow as pa
+
+        import hyperspace_tpu.ops.filter as F
+        from hyperspace_tpu.io.columnar import ColumnarBatch
+        from hyperspace_tpu.ops.filter import fused_range_mask
+        from hyperspace_tpu.plan import expressions as E
+
+        batch = ColumnarBatch.from_arrow(
+            pa.table(
+                {
+                    "i": pa.array(
+                        [2**62, 2**62 + 1, -(2**62) - 1, 0] * 10_000,
+                        type=pa.int64(),
+                    )
+                }
+            )
+        )
+        for cond in [
+            E.Col("i") > float(2**62),
+            E.Col("i") <= float(2**62),
+            E.Col("i") >= -float(2**62),
+        ]:
+            ref = E.filter_mask(cond, batch)
+            old = F._NATIVE_RANGE_MASK_MIN_ROWS
+            try:
+                F._NATIVE_RANGE_MASK_MIN_ROWS = 1
+                got = fused_range_mask(cond, batch)
+            finally:
+                F._NATIVE_RANGE_MASK_MIN_ROWS = old
+            assert got is not None
+            np.testing.assert_array_equal(got, ref, err_msg=repr(cond))
+
+    def test_fused_dispatch_matches_interpreter(self):
+        """fused_range_mask (native leg forced) ≡ the expression
+        interpreter's final mask on a supported conjunction."""
+        import hyperspace_tpu.ops.filter as F
+        from hyperspace_tpu.ops.filter import fused_range_mask
+        from hyperspace_tpu.plan import expressions as E
+
+        batch = self._batch(30_000)
+        cond = (
+            (E.Col("i") >= -(2**61))
+            & (E.Col("f") > -0.25)
+            & (E.Col("f") <= 0.25)
+            & (E.Col("m") < 20_000)
+        )
+        ref = E.filter_mask(cond, batch)
+        old = F._NATIVE_RANGE_MASK_MIN_ROWS
+        try:
+            F._NATIVE_RANGE_MASK_MIN_ROWS = 1
+            got = fused_range_mask(cond, batch)
+        finally:
+            F._NATIVE_RANGE_MASK_MIN_ROWS = old
+        assert got is not None
+        np.testing.assert_array_equal(got, ref)

@@ -1,0 +1,486 @@
+"""Range serve plane: zone-map pruning superset-safety property suite.
+
+The contract under test (docs/range-serve.md, indexes/zonemaps.py):
+pruned-scan ≡ full-scan+mask for EVERY predicate and dtype — pruning may
+only drop files/row groups no matching row can live in. The suite runs
+the three-way differential (rangeprune on ≡ rangeprune off ≡ unindexed)
+across the dtype matrix (ints, floats with NaN, strings, dates, tz
+timestamps, nullable columns), checks lifecycle operations
+(refresh/optimize) keep zone maps consistent, and exercises stale
+sidecar eviction, the hybrid-scan fallback, and the z-address range
+decomposition's covering property.
+"""
+
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.indexes import zonemaps
+from hyperspace_tpu.indexes.zorder import ZOrderCoveringIndexConfig
+from hyperspace_tpu.plan import expressions as E
+
+
+@pytest.fixture
+def s1(session_factory):
+    """Mesh-1 session: pruning is a host read-side feature with no mesh
+    axis; one size keeps the dtype matrix fast."""
+    return session_factory(1)
+
+
+def _write_files(tmp_path, name, table, n_files=4):
+    d = tmp_path / name
+    d.mkdir()
+    n = table.num_rows
+    for i in range(n_files):
+        lo, hi = i * n // n_files, (i + 1) * n // n_files
+        pq.write_table(table.slice(lo, hi - lo), str(d / f"part{i}.parquet"))
+    return str(d)
+
+
+def _three_way(session, df, cond_fn, select_cols):
+    """collect() with rangeprune on vs off vs unindexed; all must be
+    bit-identical (same rows, same order)."""
+    q = lambda: df.filter(cond_fn(df)).select(*select_cols).collect()
+    session.enable_hyperspace()
+    session.conf.set(C.SERVE_RANGEPRUNE_ENABLED, True)
+    zonemaps.invalidate_local_cache()
+    on = q()
+    session.conf.set(C.SERVE_RANGEPRUNE_ENABLED, False)
+    off = q()
+    session.conf.unset(C.SERVE_RANGEPRUNE_ENABLED)
+    session.disable_hyperspace()
+    raw = q()
+    assert on.equals(off), "rangeprune on/off results differ"
+    assert on.num_rows == raw.num_rows, (on.num_rows, raw.num_rows)
+    return on
+
+
+class TestIntervalExtraction:
+    SCHEMA = {
+        "i": pa.int64(),
+        "f": pa.float64(),
+        "s": pa.string(),
+        "d": pa.date32(),
+    }
+
+    def test_range_conjuncts_intersect(self):
+        cond = (E.Col("i") >= 3) & (E.Col("i") < 10) & (E.Col("i") > 4)
+        iv = zonemaps.predicate_intervals(cond, self.SCHEMA)["i"]
+        assert (iv.lo, iv.lo_strict, iv.hi, iv.hi_strict) == (4, True, 10, True)
+
+    def test_eq_and_contradiction(self):
+        cond = (E.Col("i") == 5) & (E.Col("i") > 7)
+        assert zonemaps.predicate_intervals(cond, self.SCHEMA)["i"].empty
+
+    def test_in_hull_and_ne_abstains(self):
+        cond = E.Col("i").isin(3, 9, 5) & (E.Col("f") != 1.0)
+        out = zonemaps.predicate_intervals(cond, self.SCHEMA)
+        assert (out["i"].lo, out["i"].hi) == (3, 9)
+        assert "f" not in out  # != never contributes
+
+    def test_temporal_lowering_matches_engine(self):
+        # sub-day instant on a date column: equality can never hold
+        cond = E.Col("d") == "2020-01-01T12:00:00"
+        assert zonemaps.predicate_intervals(cond, self.SCHEMA)["d"].empty
+        # range ops snap between ticks, op-aware
+        cond = E.Col("d") > "2020-01-01T12:00:00"
+        iv = zonemaps.predicate_intervals(cond, self.SCHEMA)["d"]
+        assert iv.lo is not None and not iv.empty
+
+    def test_string_columns_str_cast(self):
+        cond = (E.Col("s") >= "b") & (E.Col("s") < "m")
+        iv = zonemaps.predicate_intervals(cond, self.SCHEMA)["s"]
+        assert (iv.lo, iv.hi) == ("b", "m")
+
+    def test_case_insensitive_and_or_abstains(self):
+        cond = (E.Col("I") >= 1) & ((E.Col("f") > 0) | (E.Col("i") < 0))
+        out = zonemaps.predicate_intervals(cond, self.SCHEMA)
+        assert out["i"].lo == 1 and "f" not in out
+
+
+class TestZBoxRanges:
+    """The decomposition's covering property: the union of emitted
+    ranges contains EVERY z-address inside the box (over-covering is
+    allowed, under-covering never)."""
+
+    @staticmethod
+    def _z(x, y, bits):
+        z = 0
+        for t in range(2 * bits):
+            col = (x, y)[t % 2]
+            bit = (col >> (bits - 1 - t // 2)) & 1
+            z = (z << 1) | bit
+        return z
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_covers_box(self, seed):
+        from hyperspace_tpu.ops.zorder import z_box_ranges
+
+        bits = 4
+        rng = np.random.default_rng(seed)
+        lo = rng.integers(0, 1 << bits, 2)
+        hi = [int(rng.integers(l, 1 << bits)) for l in lo]
+        ranges = z_box_ranges(list(map(int, lo)), hi, bits, max_ranges=8)
+        for x in range(int(lo[0]), hi[0] + 1):
+            for y in range(int(lo[1]), hi[1] + 1):
+                z = self._z(x, y, bits)
+                assert any(a <= z <= b for a, b in ranges), (x, y, z)
+
+    def test_full_box_is_one_range(self):
+        from hyperspace_tpu.ops.zorder import z_box_ranges
+
+        ranges = z_box_ranges([0, 0], [15, 15], 4)
+        assert ranges == [(0, 255)]
+
+    def test_budget_caps_range_count(self):
+        from hyperspace_tpu.ops.zorder import z_box_ranges
+
+        ranges = z_box_ranges([1, 3], [14, 11], 8, max_ranges=4)
+        assert len(ranges) <= 4 * 4 + 1
+
+
+def _dtype_tables(rng, n=8000):
+    base = np.datetime64("2019-01-01")
+    days = np.sort(rng.integers(0, 900, n))
+    yield "ints", {
+        "c": pa.array(np.sort(rng.integers(-1000, 1000, n)), type=pa.int64()),
+        "p": pa.array(rng.integers(0, 10, n), type=pa.int64()),
+    }, lambda df: (df["c"] >= -100) & (df["c"] < 250)
+    f = rng.normal(0, 100, n)
+    f[::31] = np.nan
+    yield "floats_nan", {
+        "c": pa.array(f),
+        "p": pa.array(rng.integers(0, 10, n), type=pa.int64()),
+    }, lambda df: (df["c"] > -50.0) & (df["c"] <= 50.0)
+    yield "strings", {
+        "c": pa.array([f"k{int(v):06d}" for v in rng.integers(0, 5000, n)]),
+        "p": pa.array(rng.integers(0, 10, n), type=pa.int64()),
+    }, lambda df: (df["c"] >= "k001000") & (df["c"] < "k002000")
+    yield "dates", {
+        "c": pa.array((base + days).astype("datetime64[D]")),
+        "p": pa.array(rng.integers(0, 10, n), type=pa.int64()),
+    }, lambda df: (
+        (df["c"] >= np.datetime64("2019-06-01"))
+        & (df["c"] <= np.datetime64("2019-09-01"))
+    )
+    yield "ts_tz", {
+        "c": pa.array(
+            (base + days).astype("datetime64[us]"),
+            type=pa.timestamp("us", tz="UTC"),
+        ),
+        "p": pa.array(rng.integers(0, 10, n), type=pa.int64()),
+    }, lambda df: (df["c"] >= "2019-06-01") & (df["c"] < "2019-09-01")
+    yield "nullable_int", {
+        "c": pa.array(
+            [None if i % 11 == 0 else int(v) for i, v in enumerate(
+                np.sort(rng.integers(0, 10_000, n))
+            )],
+            type=pa.int64(),
+        ),
+        "p": pa.array(rng.integers(0, 10, n), type=pa.int64()),
+    }, lambda df: (df["c"] > 2000) & (df["c"] <= 4000)
+
+
+class TestSupersetSafetyMatrix:
+    """pruned ≡ unpruned across the dtype matrix, served by a z-order
+    index (ANY indexed column may appear in the predicate, and the
+    z-span decomposition path runs too)."""
+
+    def test_dtype_matrix(self, s1, tmp_path):
+        hs = Hyperspace(s1)
+        rng = np.random.default_rng(7)
+        for name, arrays, cond_fn in _dtype_tables(rng):
+            d = _write_files(tmp_path, name, pa.table(arrays))
+            df = s1.read.parquet(d)
+            hs.create_index(
+                df, ZOrderCoveringIndexConfig(f"z_{name}", ["c"], ["p"])
+            )
+            out = _three_way(s1, df, cond_fn, ["c", "p"])
+            # sanity: the predicate actually selects a strict subset
+            assert 0 < out.num_rows < pa.table(arrays).num_rows, name
+            hs.delete_index(f"z_{name}")
+            hs.vacuum_index(f"z_{name}")
+
+    def test_eq_and_in_predicates(self, s1, tmp_path):
+        hs = Hyperspace(s1)
+        rng = np.random.default_rng(11)
+        arrays = {
+            "c": pa.array(
+                np.sort(rng.integers(0, 500, 6000)), type=pa.int64()
+            ),
+            "p": pa.array(rng.integers(0, 10, 6000), type=pa.int64()),
+        }
+        d = _write_files(tmp_path, "eqin", pa.table(arrays))
+        df = s1.read.parquet(d)
+        hs.create_index(df, ZOrderCoveringIndexConfig("z_eqin", ["c"], ["p"]))
+        _three_way(s1, df, lambda df: df["c"] == 123, ["c", "p"])
+        _three_way(
+            s1, df, lambda df: df["c"].isin(5, 123, 499), ["c", "p"]
+        )
+        # contradiction: prunes everything, still equals the mask path
+        out = _three_way(
+            s1, df, lambda df: (df["c"] > 400) & (df["c"] < 100), ["c", "p"]
+        )
+        assert out.num_rows == 0
+
+    def test_string_allnull_and_missing_stats(self, s1, tmp_path):
+        """A file holding only NULL strings must prune under a string
+        comparison (nulls never satisfy it) without tripping the
+        object-array compares; results stay three-way identical."""
+        hs = Hyperspace(s1)
+        d = tmp_path / "strnull"
+        d.mkdir()
+        t1 = pa.table(
+            {
+                "c": pa.array([f"v{i:04d}" for i in range(2000)]),
+                "p": pa.array(np.arange(2000), type=pa.int64()),
+            }
+        )
+        t2 = pa.table(
+            {
+                "c": pa.array([None] * 500, type=pa.string()),
+                "p": pa.array(np.arange(500), type=pa.int64()),
+            }
+        )
+        pq.write_table(t1, str(d / "a.parquet"))
+        pq.write_table(t2, str(d / "b.parquet"))
+        df = s1.read.parquet(str(d))
+        hs.create_index(df, ZOrderCoveringIndexConfig("z_sn", ["c"], ["p"]))
+        out = _three_way(
+            s1, df, lambda df: (df["c"] >= "v0100") & (df["c"] < "v0200"),
+            ["c", "p"],
+        )
+        assert out.num_rows == 100
+
+    def test_pruning_actually_prunes(self, s1, tmp_path):
+        """On date-sorted files, a narrow range drops files AND row
+        groups — and the telemetry says so."""
+        hs = Hyperspace(s1)
+        rng = np.random.default_rng(13)
+        n = 8000
+        arrays = {
+            "c": pa.array(
+                np.sort(rng.integers(0, 100_000, n)), type=pa.int64()
+            ),
+            "p": pa.array(rng.integers(0, 10, n), type=pa.int64()),
+        }
+        d = _write_files(tmp_path, "prunes", pa.table(arrays))
+        df = s1.read.parquet(d)
+        # small target bytes → several z files, so FILE-level pruning has
+        # something to drop even below one 64k row group
+        s1.conf.set(C.ZORDER_TARGET_SOURCE_BYTES_PER_PARTITION, 16 * 1024)
+        hs.create_index(df, ZOrderCoveringIndexConfig("z_pr", ["c"], ["p"]))
+        s1.conf.unset(C.ZORDER_TARGET_SOURCE_BYTES_PER_PARTITION)
+        s1.enable_hyperspace()
+        zonemaps.invalidate_local_cache()
+        df.filter((df["c"] >= 10_000) & (df["c"] < 12_000)).select(
+            "c", "p"
+        ).collect()
+        st = zonemaps.last_prune_stats
+        assert st["files_kept"] < st["files_total"] or (
+            st["row_groups_kept"] < st["row_groups_total"]
+        ), st
+        assert st["zonemap_files_sidecar"] > 0  # capture fed the serve
+        s1.disable_hyperspace()
+
+
+class TestRowGroupNarrowing:
+    def test_row_group_read_matches_full(self, tmp_path):
+        from hyperspace_tpu.io import parquet as pio
+
+        rng = np.random.default_rng(3)
+        t = pa.table({"a": rng.integers(0, 100, 10_000)})
+        p = str(tmp_path / "rg.parquet")
+        pq.write_table(t, p, row_group_size=1000)
+        full = pio.read_table_row_groups([p], [None], ["a"])
+        assert full.equals(pq.read_table(p))
+        sel = pio.read_table_row_groups([p], [(0, 3, 7)], ["a"])
+        ref = pa.concat_tables(
+            [pq.ParquetFile(p).read_row_groups([i], columns=["a"]) for i in (0, 3, 7)]
+        )
+        assert sel.equals(ref)
+        empty = pio.read_table_row_groups([p], [()], ["a"])
+        assert empty.num_rows == 0 and empty.column_names == ["a"]
+
+    def test_multi_group_narrowing_end_to_end(self, s1, tmp_path):
+        """>64k rows → multiple row groups per index file; a narrow
+        range must keep a minority of groups with identical results."""
+        hs = Hyperspace(s1)
+        rng = np.random.default_rng(5)
+        n = 200_000
+        arrays = {
+            "c": pa.array(np.sort(rng.integers(0, 10**6, n)), type=pa.int64()),
+            "p": pa.array(rng.integers(0, 10, n), type=pa.int64()),
+        }
+        d = _write_files(tmp_path, "big", pa.table(arrays), n_files=2)
+        df = s1.read.parquet(d)
+        hs.create_index(df, ZOrderCoveringIndexConfig("z_big", ["c"], ["p"]))
+        out = _three_way(
+            s1,
+            df,
+            lambda df: (df["c"] >= 500_000) & (df["c"] < 520_000),
+            ["c", "p"],
+        )
+        assert out.num_rows > 0
+        st = zonemaps.last_prune_stats
+        assert st["row_groups_total"] >= 3
+        assert st["row_groups_kept"] < st["row_groups_total"], st
+
+
+class TestLifecycleConsistency:
+    def test_refresh_and_optimize_keep_maps_consistent(self, s1, tmp_path):
+        from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+
+        hs = Hyperspace(s1)
+        rng = np.random.default_rng(17)
+        n = 6000
+        arrays = {
+            "k": pa.array(np.sort(rng.integers(0, 5000, n)), type=pa.int64()),
+            "p": pa.array(rng.integers(0, 10, n), type=pa.int64()),
+        }
+        d = _write_files(tmp_path, "life", pa.table(arrays))
+        df = s1.read.parquet(d)
+        hs.create_index(df, CoveringIndexConfig("ci", ["k"], ["p"]))
+        cond = lambda df: (df["k"] >= 1000) & (df["k"] < 1500)
+        _three_way(s1, df, cond, ["k", "p"])
+        # append + incremental refresh: the new version dir gets its own
+        # sidecar; old files keep theirs
+        extra = pa.table(
+            {
+                "k": pa.array(
+                    rng.integers(0, 5000, 500), type=pa.int64()
+                ),
+                "p": pa.array(rng.integers(0, 10, 500), type=pa.int64()),
+            }
+        )
+        pq.write_table(extra, os.path.join(d, "part9.parquet"))
+        s1.index_manager.clear_cache()
+        hs.refresh_index("ci", C.REFRESH_MODE_INCREMENTAL)
+        df2 = s1.read.parquet(d)
+        _three_way(s1, df2, cond, ["k", "p"])
+        # optimize compacts buckets into a new version dir + fresh sidecar
+        hs.optimize_index("ci", mode=C.OPTIMIZE_MODE_FULL)
+        _three_way(s1, df2, cond, ["k", "p"])
+        entry = s1.index_manager.get_index_log_entry("ci")
+        dirs = {os.path.dirname(f) for f in entry.content.files}
+        for vd in dirs:
+            assert os.path.exists(os.path.join(vd, zonemaps.SIDECAR_NAME))
+
+
+class TestStaleEviction:
+    def test_rewritten_file_ignores_stale_sidecar(self, tmp_path):
+        rng = np.random.default_rng(19)
+        p = str(tmp_path / "f.parquet")
+        pq.write_table(
+            pa.table({"a": rng.integers(0, 100, 1000)}), p, row_group_size=500
+        )
+
+        class _FakeIndex:
+            kind = "CoveringIndex"
+            indexed_columns = ["a"]
+
+        assert zonemaps.capture_index_dir(str(tmp_path), _FakeIndex())
+        side = zonemaps._sidecar_for_dir(str(tmp_path))
+        assert zonemaps._file_stats_from_sidecar(p, side) is not None
+        # rewrite the file: size/mtime change, the sidecar entry is stale
+        pq.write_table(
+            pa.table({"a": rng.integers(500, 600, 2000)}),
+            p,
+            row_group_size=500,
+        )
+        assert zonemaps._file_stats_from_sidecar(p, side) is None
+        # assembly falls back to the (fresh) footer and stays correct
+        zd = zonemaps.assemble_zone_data((p,), {"a": pa.int64()})
+        assert zd.footer_files == 1 and zd.sidecar_files == 0
+        cz = zd.cols["a"]
+        assert cz.has.all() and float(cz.lo.min()) >= 500.0
+
+    def test_serve_cache_zonemap_kind_evicts(self, tmp_path):
+        from hyperspace_tpu.execution.serve_cache import ServeCache
+
+        rng = np.random.default_rng(23)
+        p = str(tmp_path / "g.parquet")
+        pq.write_table(pa.table({"a": rng.integers(0, 100, 100)}), p)
+
+        import dataclasses
+
+        from hyperspace_tpu.plan.nodes import Relation
+
+        rel = Relation(
+            root_paths=(str(tmp_path),),
+            files=(p,),
+            fmt="parquet",
+            schema_fields=(("a", pa.int64()),),
+            index_info=("x", 1, "CI"),
+        )
+        cache = ServeCache(1 << 20)
+        zonemaps.invalidate_local_cache()
+        zd, hit = zonemaps.zone_data_for(rel, cache)
+        assert not hit and len(cache) == 1
+        zonemaps.invalidate_local_cache()
+        _zd2, hit2 = zonemaps.zone_data_for(rel, cache)
+        assert hit2
+        assert cache.evict_kind("zonemap") == 1
+        dataclasses.replace(rel)  # keep dataclasses import honest
+
+
+class TestHybridFallback:
+    def test_appended_files_read_in_full(self, s1, tmp_path):
+        """Hybrid-scan filter: the index side prunes, the appended-files
+        compensation side (no index_info) is never narrowed — and the
+        union result matches the unindexed scan exactly."""
+        from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+
+        hs = Hyperspace(s1)
+        rng = np.random.default_rng(29)
+        n = 4000
+        arrays = {
+            "k": pa.array(np.sort(rng.integers(0, 5000, n)), type=pa.int64()),
+            "p": pa.array(rng.integers(0, 10, n), type=pa.int64()),
+        }
+        d = _write_files(tmp_path, "hyb", pa.table(arrays))
+        df = s1.read.parquet(d)
+        hs.create_index(df, CoveringIndexConfig("hci", ["k"], ["p"]))
+        extra = pa.table(
+            {
+                "k": pa.array(rng.integers(0, 5000, 300), type=pa.int64()),
+                "p": pa.array(rng.integers(0, 10, 300), type=pa.int64()),
+            }
+        )
+        pq.write_table(extra, os.path.join(d, "appended.parquet"))
+        s1.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, True)
+        s1.index_manager.clear_cache()
+        df2 = s1.read.parquet(d)
+        out = _three_way(
+            s1, df2, lambda df: (df["k"] >= 1000) & (df["k"] < 2000), ["k", "p"]
+        )
+        assert out.num_rows > 0
+        s1.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, False)
+
+
+class TestSidecarFormat:
+    def test_sidecar_roundtrip_values(self, tmp_path):
+        import datetime as dt
+
+        for v in [
+            None,
+            True,
+            -5,
+            2.5,
+            "abc",
+            dt.date(2020, 1, 2),
+            dt.datetime(2020, 1, 2, 3, 4, 5, 123456),
+            dt.datetime(2020, 1, 2, tzinfo=dt.timezone.utc),
+            dt.time(23, 59, 59),
+            dt.timedelta(days=2, seconds=3, microseconds=4),
+        ]:
+            enc = zonemaps._enc_stat(v)
+            json.dumps(enc)  # must be JSON-serializable
+            assert zonemaps._dec_stat(enc) == v
+        assert zonemaps._dec_stat(zonemaps._enc_stat(object())) is None
