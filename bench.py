@@ -104,6 +104,20 @@ def main() -> None:
     reps = int(os.environ.get("HS_BENCH_REPS", 5))
     num_buckets = int(os.environ.get("HS_BENCH_BUCKETS", 8))
 
+    # HS_BENCH_FORCE_CPU_DEVICES=n: simulate an n-device CPU mesh (the
+    # smoke uses 8 so the mesh ladder rows exercise the sharded tail on
+    # every CI pass). Must be set before the jax backend initializes; no
+    # effect unless requested — a real chip keeps its real devices.
+    force_dev = os.environ.get("HS_BENCH_FORCE_CPU_DEVICES")
+    if force_dev:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={int(force_dev)}"
+            ).strip()
+
     import jax
 
     from hyperspace_tpu import constants as C
@@ -551,6 +565,122 @@ def main() -> None:
             finally:
                 shutil.rmtree(rung_dir, ignore_errors=True)
 
+        # --- mesh build/serve ladder: the scale-out story (ROADMAP item
+        # 2). Per (rows, devices) rung: a warm covering build — on >1
+        # devices the shard_map all-to-all shuffle plus the sharded
+        # sort+write tail (hyperspace.build.shardedTail.enabled) — and
+        # the co-bucketed indexed join served with per-shard prepare +
+        # merge. Stage seconds are busy time (sort/write sum across
+        # shard tails; the excess over tail_wall is the sharding win);
+        # shuffle telemetry records exchange cap + per-peer skew.
+        from hyperspace_tpu.indexes.covering_build import (
+            last_build_telemetry,
+        )
+
+        mesh_sizes_env = os.environ.get("HS_BENCH_MESH", "1,2,8")
+        mesh_rows_env = os.environ.get(
+            "HS_BENCH_MESH_ROWS", "4000000,64000000"
+        )
+        avail = len(jax.devices())
+        mesh_sizes = [
+            d
+            for d in (
+                int(x) for x in mesh_sizes_env.split(",") if x.strip()
+            )
+            if 1 <= d <= avail
+        ]
+        mesh_ladder = []
+        for rung_rows in [
+            int(x) for x in mesh_rows_env.split(",") if x.strip()
+        ]:
+            rung_dir = os.path.join(tmp, f"mesh_{rung_rows}")
+            try:
+                mldir, modir = gen_data(
+                    rung_dir, rung_rows, max(rung_rows // 8, 1)
+                )
+                for D in mesh_sizes:
+                    msession = HyperspaceSession(devices=jax.devices()[:D])
+                    msession.conf.set(
+                        C.INDEX_SYSTEM_PATH,
+                        os.path.join(rung_dir, f"indexes_d{D}"),
+                    )
+                    msession.conf.set(C.INDEX_NUM_BUCKETS, num_buckets)
+                    mhs = Hyperspace(msession)
+                    mdf = msession.read.parquet(mldir)
+                    mcfg = CoveringIndexConfig(
+                        "mesh_l_idx",
+                        ["l_orderkey"],
+                        ["l_shipdate", "l_quantity", "l_extendedprice"],
+                    )
+                    mhs.create_index(mdf, mcfg)  # warm caches/compiles
+                    mhs.delete_index("mesh_l_idx")
+                    mhs.vacuum_index("mesh_l_idx")
+                    msession.index_manager.clear_cache()
+                    t0 = time.perf_counter()
+                    mhs.create_index(mdf, mcfg)
+                    m_warm = time.perf_counter() - t0
+                    m_stages = {
+                        k: round(v, 3)
+                        for k, v in last_build_breakdown.items()
+                    }
+                    m_shuffle = {
+                        k: v for k, v in last_build_telemetry.items()
+                    }
+                    modf = msession.read.parquet(modir)
+                    mhs.create_index(
+                        modf,
+                        CoveringIndexConfig(
+                            "mesh_o_idx", ["o_orderkey"], ["o_custkey"]
+                        ),
+                    )
+                    msession.enable_hyperspace()
+
+                    def q_mjoin(o=modf, i=mdf):
+                        return o.join(
+                            i, on=o["o_orderkey"] == i["l_orderkey"]
+                        ).select("o_orderkey", "o_custkey", "l_quantity")
+
+                    mplan = q_mjoin().explain()
+                    if mplan.count("Hyperspace(Type: CI") != 2:
+                        log(
+                            f"WARNING: mesh join (D={D}) not index-served:"
+                            f"\n{mplan}"
+                        )
+                    q_mjoin().collect()  # warmup
+                    m_join = timeit(lambda: q_mjoin().collect(), reps)
+                    m_join_stages = {
+                        k: round(v * 1e3, 2)
+                        for k, v in join_exec.last_serve_breakdown.items()
+                    }
+                    mesh_ladder.append(
+                        {
+                            "rows": rung_rows,
+                            "devices": D,
+                            "build_warm_s": round(m_warm, 3),
+                            "build_rows_per_sec": round(rung_rows / m_warm),
+                            "build_stage_seconds": m_stages,
+                            "shuffle": m_shuffle,
+                            "join_indexed_p50_ms": round(
+                                m_join["p50"] * 1e3, 2
+                            ),
+                            "join_indexed_iqr_ms": round(
+                                m_join["iqr"] * 1e3, 2
+                            ),
+                            "join_serve_stage_ms": m_join_stages,
+                        }
+                    )
+                    log(
+                        f"mesh ladder {rung_rows:,} rows x {D} devices: "
+                        f"build {m_warm:.2f}s "
+                        f"({rung_rows / m_warm:,.0f} rows/s), join "
+                        f"{m_join['p50'] * 1e3:.1f}ms; stages: {m_stages}"
+                        f"; shuffle: {m_shuffle}"
+                    )
+            except MemoryError:
+                log(f"mesh ladder {rung_rows:,} rows: skipped (MemoryError)")
+            finally:
+                shutil.rmtree(rung_dir, ignore_errors=True)
+
         # headline: geometric mean of the three UNCACHED serve-path
         # speedups — stable under one path's unindexed baseline improving,
         # and directly comparable with rounds 1-4. The serve-server
@@ -647,6 +777,7 @@ def main() -> None:
                     "ds_prune_files_scanned": ds_files,
                     "ds_prune_files_total": ds_total,
                     "build_ladder": ladder,
+                    "mesh_ladder": mesh_ladder,
                 }
             )
         )

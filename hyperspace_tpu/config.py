@@ -94,6 +94,16 @@ class Config:
         )
 
     @property
+    def build_sharded_tail(self) -> bool:
+        """Device-local build/serve tail on a >1-device mesh: per-shard
+        sort + write and per-shard join prepare/merge, union at the
+        edge (bit-identical to the single-tail path; False = old path)."""
+        return self.get_bool(
+            C.BUILD_SHARDED_TAIL_ENABLED,
+            C.BUILD_SHARDED_TAIL_ENABLED_DEFAULT,
+        )
+
+    @property
     def lineage_enabled(self) -> bool:
         return self.get_bool(
             C.INDEX_LINEAGE_ENABLED, C.INDEX_LINEAGE_ENABLED_DEFAULT

@@ -114,6 +114,28 @@ INDEX_BUILD_MEMORY_BUDGET_DEFAULT = 0
 INDEX_BUILD_PARTITION_FIRST = "hyperspace.index.build.partitionFirst"
 INDEX_BUILD_PARTITION_FIRST_DEFAULT = True
 
+# Sharded build/serve tail (docs/MULTIHOST.md): on a >1-device mesh,
+# bucket ownership stays device-local past the exchange — each shard's
+# bucket range runs its own partition-first sort + bucketed parquet
+# write (build) and its own prepare + merge-join (serve) concurrently,
+# with a cheap per-bucket union at the edge, instead of serializing the
+# post-exchange tail through one global permutation on the host. Every
+# bucket file and every join row is bit-identical either way (a bucket
+# lives wholly on one shard); the flag restores the old single-tail
+# path for A/B timing and as an escape hatch. No effect on a 1-device
+# mesh.
+BUILD_SHARDED_TAIL_ENABLED = "hyperspace.build.shardedTail.enabled"
+BUILD_SHARDED_TAIL_ENABLED_DEFAULT = True
+
+# Warn when the bucket shuffle's per-(shard, peer) send-count skew
+# (max/mean) exceeds this: the exchange pads every slot to the max
+# count, so one hot bucket silently inflates exchange memory by ~skew×.
+# Tiny builds skip the warning (below the row floor the padded buffers
+# are KBs — the ratio is always noisy there); telemetry records the
+# ratio regardless.
+BUILD_SHUFFLE_SKEW_WARN_RATIO = 4.0
+BUILD_SHUFFLE_SKEW_WARN_MIN_ROWS = 1 << 12
+
 # Z-order (IndexConstants.scala:59-74)
 ZORDER_TARGET_SOURCE_BYTES_PER_PARTITION = (
     "hyperspace.index.zorder.targetSourceBytesPerPartition"

@@ -186,6 +186,19 @@ def _serve_pipeline_on(session) -> bool:
     return session is not None and session.conf.serve_pipeline_enabled
 
 
+def _serve_shards(session) -> int:
+    """Shard count for the device-local serve tail
+    (``hyperspace.build.shardedTail.enabled``, one flag for both
+    planes): the session mesh size when the flag is on and the mesh has
+    more than one device, else 1 (single-tail scheduling). The shard
+    layout is the build's bucket ownership (``bucket % D``) — each
+    worker prepares and merges only the buckets its shard owns, with a
+    per-bucket union at the edge (bit-identical output)."""
+    if session is None or not session.conf.build_sharded_tail:
+        return 1
+    return int(session.runtime.mesh.devices.size)
+
+
 def _cacheable_scan(rel) -> bool:
     """Only clean INDEX scans are cached (index data files are immutable
     and bounded; pinning arbitrary source tables in RAM is not this
@@ -462,7 +475,9 @@ def _exec_join(plan: Join, needed: Set[str], session) -> ColumnarBatch:
             session.conf.device_join_min_rows if session is not None else 0
         )
         joined = (
-            co_bucketed_join_prepared(lp, rp, on, mesh, min_rows)
+            co_bucketed_join_prepared(
+                lp, rp, on, mesh, min_rows, num_shards=_serve_shards(session)
+            )
             if lp is not None and rp is not None
             else None
         )
@@ -564,7 +579,9 @@ def _prepared_join_side(
     if _serve_pipeline_on(session) and (cache is None or key is not None):
         stream = _bucket_stream(plan, needed, session, bucket_cols)
         if stream is not None:
-            prep = prepare_join_side_pipelined(stream, key_cols)
+            prep = prepare_join_side_pipelined(
+                stream, key_cols, num_shards=_serve_shards(session)
+            )
             if prep is not None and key is not None:
                 cache.put(key, prep, prep.nbytes)
             return prep

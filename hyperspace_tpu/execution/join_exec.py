@@ -78,13 +78,35 @@ def _stage_add(stage: str, t0: float) -> None:
         )
 
 
-def _match_workers(n_buckets: int, total_rows: int) -> int:
-    """Thread count for the per-bucket match fan-out (1 = stay inline)."""
-    if total_rows < _PAR_MATCH_MIN_ROWS or n_buckets <= 1:
+def _match_workers(n_tasks: int, total_rows: int) -> int:
+    """Thread count for the match fan-out (1 = stay inline). The task
+    unit is a bucket on a 1-shard serve, a whole shard's bucket range on
+    a sharded serve."""
+    if total_rows < _PAR_MATCH_MIN_ROWS or n_tasks <= 1:
         return 1
     from hyperspace_tpu import native
 
-    return max(1, min(n_buckets, native._cores(), 8))
+    return max(1, min(n_tasks, native._cores(), 8))
+
+
+def _shard_tasks(buckets: Tuple[int, ...], num_shards: int) -> List[List[int]]:
+    """Bucket POSITIONS grouped into match/prepare task units. With
+    ``num_shards > 1`` each unit is one mesh shard's bucket range
+    (``bucket % num_shards`` — the build's ownership layout, shared via
+    ``parallel/mesh.bucket_owner_groups``), mirroring a device serving
+    only its own buckets; otherwise one unit per bucket. Large shard
+    ranges split within a shard so a small mesh never caps the thread
+    fan-out below the core budget. Grouping only changes scheduling:
+    results are always collected per bucket position and unioned in
+    position order, so the output is identical for every grouping."""
+    if num_shards <= 1:
+        return [[i] for i in range(len(buckets))]
+    from hyperspace_tpu import native
+    from hyperspace_tpu.parallel.mesh import bucket_owner_groups
+
+    return bucket_owner_groups(
+        buckets, num_shards, min_tasks=max(1, min(native._cores(), 8))
+    )
 
 
 def _stable_argsort_i64(a: np.ndarray, n_threads: Optional[int] = None):
@@ -335,6 +357,7 @@ def prepare_join_side(
 def prepare_join_side_pipelined(
     items: Iterable[Tuple[int, Callable[[], ColumnarBatch]]],
     key_cols: List[str],
+    num_shards: int = 1,
 ) -> Optional[PreparedJoinSide]:
     """Streaming twin of :func:`prepare_join_side`: consumes
     ``(bucket, fetch)`` pairs in ascending bucket order, computing each
@@ -345,7 +368,13 @@ def prepare_join_side_pipelined(
     per-row functions, so per-bucket computation concatenates to exactly
     the concat-then-compute result, and the global sortedness test
     ignores bucket boundaries in both formulations. Returns None for an
-    empty stream (the executor's empty-side contract)."""
+    empty stream (the executor's empty-side contract).
+
+    ``num_shards > 1`` runs the prepare device-locally: one worker per
+    mesh shard, each preparing only the buckets its shard owns
+    (``bucket % num_shards``, the build's ownership layout), with the
+    per-bucket states unioned back into ascending bucket order at the
+    edge — the same rows in the same order either way."""
     from hyperspace_tpu.ops.join import combine_reps_np
 
     items = list(items)
@@ -370,19 +399,51 @@ def prepare_join_side_pipelined(
     # other scan-pool futures — the deadlock discipline lives there),
     # then runs the reps/combine passes, whose numpy kernels release the
     # GIL on large arrays. Scaled to cores; 1 worker degenerates to the
-    # plain in-order loop.
+    # plain in-order loop. On a sharded serve the unit of work is a
+    # shard's whole bucket range instead of one bucket.
     from hyperspace_tpu import native
 
-    workers = min(4, max(1, native._cores() - 1), len(items))
-    if workers > 1:
-        from concurrent.futures import ThreadPoolExecutor
+    if num_shards > 1 and len(items) > 1:
+        from hyperspace_tpu.parallel.mesh import bucket_owner_groups
 
-        with ThreadPoolExecutor(
-            max_workers=workers, thread_name_prefix="hs-prep"
-        ) as pool:
-            rows = list(pool.map(prep_one, items))
+        # same ownership grouping as the match stage; the min_tasks
+        # floor keeps a small mesh from capping prepare below the old
+        # per-bucket pool's parallelism
+        tasks = bucket_owner_groups(
+            [it[0] for it in items],
+            num_shards,
+            min_tasks=max(1, min(4, native._cores() - 1)),
+        )
+
+        def prep_shard(group):
+            return [prep_one(items[i]) for i in group]
+
+        workers = min(len(tasks), max(1, native._cores() - 1))
+        if workers > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="hs-shardprep"
+            ) as pool:
+                shard_rows = list(pool.map(prep_shard, tasks))
+        else:
+            shard_rows = [prep_shard(g) for g in tasks]
+        # union at the edge: back to ascending bucket order (the items
+        # order), exactly the single-tail concatenation
+        rows = sorted(
+            (r for sr in shard_rows for r in sr), key=lambda r: r[0]
+        )
     else:
-        rows = [prep_one(x) for x in items]
+        workers = min(4, max(1, native._cores() - 1), len(items))
+        if workers > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="hs-prep"
+            ) as pool:
+                rows = list(pool.map(prep_one, items))
+        else:
+            rows = [prep_one(x) for x in items]
     t0 = _time.perf_counter()
     batches = [r[1] for r in rows]
     sizes = np.array([b.num_rows for b in batches], dtype=np.int64)
@@ -421,15 +482,19 @@ def _host_match_native_presorted(
     rp: PreparedJoinSide,
     l_comb: np.ndarray,
     r_comb: np.ndarray,
+    num_shards: int = 1,
 ):
     """All-buckets-presorted fast path: native count pass per bucket,
     then each bucket's pairs are emitted with its global row-offset bias
     straight into ONE preallocated (li, ri) — no per-bucket arrays, no
     offset-add passes, no final concatenate. Count and emit both fan out
     over a thread pool at serve scale (disjoint output slices; the
-    native calls release the GIL). Returns None (caller falls back) when
-    the native kernel is unavailable or a small workload wouldn't repay
-    the per-call overhead."""
+    native calls release the GIL); with ``num_shards > 1`` the fan-out
+    unit is one shard's bucket range (each worker merges only the
+    buckets its shard owns, the device-local serve layout) instead of
+    one bucket. Returns None (caller falls back) when the native kernel
+    is unavailable or a small workload wouldn't repay the per-call
+    overhead."""
     from hyperspace_tpu import native
 
     total_rows = l_comb.shape[0] + r_comb.shape[0]
@@ -440,24 +505,32 @@ def _host_match_native_presorted(
         (int(lp.sizes[b]), int(lp.offs[b]), int(rp.sizes[b]), int(rp.offs[b]))
         for b in range(B)
     ]
+    tasks = _shard_tasks(lp.buckets, num_shards)
 
-    def count_one(span):
-        lsz, loff, rsz, roff = span
+    def count_one(b):
+        lsz, loff, rsz, roff = spans[b]
         if lsz == 0 or rsz == 0:
             return 0
         return native.merge_join_count_i64(
             l_comb[loff : loff + lsz], r_comb[roff : roff + rsz]
         )
 
-    workers = _match_workers(B, total_rows)
+    def count_group(group):
+        return [(b, count_one(b)) for b in group]
+
+    workers = _match_workers(len(tasks), total_rows)
     t0 = _time.perf_counter()
     if workers > 1:
         from concurrent.futures import ThreadPoolExecutor
 
         with ThreadPoolExecutor(max_workers=workers) as pool:
-            counts = list(pool.map(count_one, spans))
+            grouped = list(pool.map(count_group, tasks))
     else:
-        counts = [count_one(s) for s in spans]
+        grouped = [count_group(g) for g in tasks]
+    counts = [0] * B
+    for pairs in grouped:
+        for b, c in pairs:
+            counts[b] = c
     if any(c is None for c in counts):
         return None
     _stage_add("match", t0)
@@ -482,13 +555,16 @@ def _host_match_native_presorted(
             roff,
         )
 
+    def emit_group(group):
+        return [emit_one(b) for b in group]
+
     if workers > 1:
         from concurrent.futures import ThreadPoolExecutor
 
         with ThreadPoolExecutor(max_workers=workers) as pool:
-            oks = list(pool.map(emit_one, range(B)))
+            oks = [ok for g in pool.map(emit_group, tasks) for ok in g]
     else:
-        oks = [emit_one(b) for b in range(B)]
+        oks = [ok for g in tasks for ok in emit_group(g)]
     _stage_add("expand", t0)
     if not all(oks):
         return None
@@ -500,6 +576,7 @@ def _host_match(
     rp: PreparedJoinSide,
     l_comb: np.ndarray,
     r_comb: np.ndarray,
+    num_shards: int = 1,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Per-bucket host match on the UNPADDED slices -> global (li, ri).
 
@@ -507,18 +584,27 @@ def _host_match(
     multi-key combines, multi-version buckets) are stable-argsorted on
     host first — measured ~10x cheaper than the device sort+transfer
     round trip on one chip. No [B, W] padding is built at all (the
-    padding only ever served the device kernel's static-shape contract)."""
+    padding only ever served the device kernel's static-shape contract).
+
+    ``num_shards > 1`` makes the fan-out unit one mesh shard's bucket
+    range (``bucket % num_shards``, the build's ownership layout) — each
+    worker merges only the buckets its shard owns; the per-bucket pair
+    arrays are then unioned in ascending bucket position, identical to
+    the per-bucket scheduling."""
     l_sorted = lp.sorted_buckets and lp.nulls is None
     r_sorted = rp.sorted_buckets and rp.nulls is None
     if l_sorted and r_sorted:
-        pair = _host_match_native_presorted(lp, rp, l_comb, r_comb)
+        pair = _host_match_native_presorted(
+            lp, rp, l_comb, r_comb, num_shards
+        )
         if pair is not None:
             return pair
     from hyperspace_tpu.ops.join import expand_match_ranges
 
     B = len(lp.sizes)
     total_rows = l_comb.shape[0] + r_comb.shape[0]
-    workers = _match_workers(B, total_rows)
+    tasks = _shard_tasks(lp.buckets, num_shards)
+    workers = _match_workers(len(tasks), total_rows)
     # when buckets fan out across threads, each per-bucket native sort
     # gets a slice of the core budget instead of claiming the machine
     sort_threads = None if workers == 1 else 1
@@ -567,13 +653,20 @@ def _host_match(
             return None
         return li, ri
 
+    def match_group(group):
+        return [(b, match_bucket(b)) for b in group]
+
+    results: List = [None] * B
     if workers > 1:
         from concurrent.futures import ThreadPoolExecutor
 
         with ThreadPoolExecutor(max_workers=workers) as pool:
-            results = list(pool.map(match_bucket, range(B)))
+            grouped = list(pool.map(match_group, tasks))
     else:
-        results = [match_bucket(b) for b in range(B)]
+        grouped = [match_group(g) for g in tasks]
+    for pairs_g in grouped:
+        for b, p in pairs_g:
+            results[b] = p
     pairs = [p for p in results if p is not None]
     z = np.zeros(0, dtype=np.int64)
     if not pairs:
@@ -668,6 +761,7 @@ def co_bucketed_join_prepared(
     on: List[Tuple[str, str]],
     mesh=None,
     device_min_rows: int = 0,
+    num_shards: int = 1,
 ) -> Optional[ColumnarBatch]:
     """Shuffle-free join of two prepared co-bucketed sides.
 
@@ -675,7 +769,10 @@ def co_bucketed_join_prepared(
     co-bucketed scans (``covering/JoinIndexRule.scala:619-634``): no
     exchange ever happens — each bucket pair is matched independently
     (host binary-search per bucket, or the compiled sharded device
-    program on a >1-device mesh).
+    program on a >1-device mesh). ``num_shards > 1`` routes the host
+    match through the device-local layout: one worker per mesh shard,
+    each merging only its own bucket range, pair arrays unioned in
+    bucket order at the edge (bit-identical output for every value).
 
     Returns the joined batch, or None when the sides share no bucket (the
     caller builds the schema-correct empty result).
@@ -705,7 +802,7 @@ def co_bucketed_join_prepared(
     # also wins for unsorted sides on one device (argsort on host beats
     # the device round trip); a >1-device mesh shards the general path.
     if both_sorted or (single_device and not force_device):
-        li, ri = _host_match(lp, rp, l_comb, r_comb)
+        li, ri = _host_match(lp, rp, l_comb, r_comb, num_shards)
     else:
         li, ri = _device_match(lp, rp, l_comb, r_comb, mesh, device_min_rows)
     # Single-key matching on the raw combined reps is exact (identity

@@ -33,6 +33,7 @@ the previous index data minus deleted-lineage rows.
 from __future__ import annotations
 
 import dataclasses
+import threading as _threading
 import time as _time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -338,14 +339,25 @@ def prepare_covering_index(ctx, source_df, config, properties: Dict[str, str]):
 # Per-stage wall times of the most recent build (scan/hash/sort/write),
 # reset at each create/refresh data op — the bench publishes these so the
 # throughput story names its bottleneck (SURVEY §7 hard part #4: measure
-# before moving parquet decode on-device).
+# before moving parquet decode on-device). Under the sharded tail the
+# sort/write stages run per shard concurrently, so those values are BUSY
+# time summed across shards (may exceed wall time — the excess over
+# ``tail_wall`` is the sharding win); ``tail_shards`` records how many
+# shard tails ran.
 last_build_breakdown: Dict[str, float] = {}
+_build_bd_lock = _threading.Lock()
+
+# Non-timing telemetry of the most recent build: the shuffle's exchange
+# capacity and per-(shard, peer) skew ratio (``parallel/shuffle.
+# last_shuffle_stats``), copied here per data op so the bench and
+# operators read one coherent snapshot.
+last_build_telemetry: Dict[str, float] = {}
 
 
 def _stage_add(name: str, t0: float) -> None:
-    last_build_breakdown[name] = (
-        last_build_breakdown.get(name, 0.0) + _time.perf_counter() - t0
-    )
+    dt = _time.perf_counter() - t0
+    with _build_bd_lock:
+        last_build_breakdown[name] = last_build_breakdown.get(name, 0.0) + dt
 
 
 def reset_build_breakdown() -> None:
@@ -353,6 +365,7 @@ def reset_build_breakdown() -> None:
     prepare_covering_index; refresh/optimize call it directly) so the
     breakdown never mixes two ops' stage times."""
     last_build_breakdown.clear()
+    last_build_telemetry.clear()
 
 
 def lazy_or_materialized(ctx, scan):
@@ -456,28 +469,48 @@ def _hash_shuffle(
 ):
     """Bucket-id half of the pipeline: murmur3 bucket ids over the key
     reps (+ mesh all-to-all when >1 device). Returns ``(buckets, reps,
-    batch)`` in post-exchange row order."""
+    batch, shard_offsets)`` in post-exchange row order; ``shard_offsets``
+    is the ``[D+1]`` per-shard row extent of the exchanged batch (rows
+    ``offsets[s]:offsets[s+1]`` hold exactly the buckets shard ``s``
+    owns), or None when no exchange ran (single device / tiny batch)."""
     t0 = _time.perf_counter()
     reps = batch.key_reps(indexed_cols)
     mesh = ctx.mesh
+    shard_offs = None
     if mesh.devices.size > 1 and batch.num_rows >= mesh.devices.size:
-        from hyperspace_tpu.parallel.shuffle import bucket_shuffle
+        from hyperspace_tpu.parallel import shuffle as _shuffle
 
         arrays, spec = _decompose(batch)
         k = reps.shape[0]
-        buckets, moved = bucket_shuffle(
-            mesh, reps, list(reps) + arrays, num_buckets
+        buckets, moved, shard_offs = _shuffle.bucket_shuffle(
+            mesh, reps, list(reps) + arrays, num_buckets,
+            with_shard_offsets=True,
         )
         reps = np.stack(moved[:k]) if k else np.zeros((0, len(buckets)))
         batch = _reassemble(spec, moved[k:])
+        with _build_bd_lock:
+            last_build_telemetry.update(
+                ("shuffle_" + k2, v)
+                for k2, v in _shuffle.last_shuffle_stats.items()
+            )
     else:
         buckets = bucket_ids_np(reps, num_buckets)
     _stage_add("hash_shuffle", t0)
-    return buckets, reps, batch
+    return buckets, reps, batch, shard_offs
 
 
 def _partition_first(ctx) -> bool:
     return ctx.session.conf.build_partition_first
+
+
+def _sharded_tail_offsets(ctx, shard_offs):
+    """The shard offsets when the device-local tail applies, else None:
+    flag on (``hyperspace.build.shardedTail.enabled``), an exchange
+    actually ran, and more than one shard holds rows."""
+    if shard_offs is None or not ctx.session.conf.build_sharded_tail:
+        return None
+    occupied = int(np.count_nonzero(np.diff(shard_offs)))
+    return shard_offs if occupied > 1 else None
 
 
 def bucketize(ctx, batch: ColumnarBatch, indexed_cols: List[str], num_buckets: int):
@@ -489,13 +522,30 @@ def bucketize(ctx, batch: ColumnarBatch, indexed_cols: List[str], num_buckets: i
     pool — working set ≈ rows/num_buckets per sort) and produces a
     permutation bit-identical to the legacy global lexsort by
     (bucket, keys...) it replaces (``hyperspace.index.build.partitionFirst``
-    = false restores the old path)."""
-    from hyperspace_tpu.ops.sort import partitioned_sort_permutation
+    = false restores the old path). On a >1-device mesh with the sharded
+    tail on, each shard's slice sorts CONCURRENTLY
+    (``ops/sort.sharded_sort_permutation``): row order is then
+    shard-major rather than globally bucket-ascending, but each bucket's
+    rows and their key-sorted order are identical — the bucketed writers
+    (``pio.bucket_runs`` / per-bucket spill) only ever observe per-bucket
+    runs."""
+    from hyperspace_tpu.ops.sort import (
+        partitioned_sort_permutation,
+        sharded_sort_permutation,
+    )
 
-    buckets, reps, batch = _hash_shuffle(ctx, batch, indexed_cols, num_buckets)
+    buckets, reps, batch, shard_offs = _hash_shuffle(
+        ctx, batch, indexed_cols, num_buckets
+    )
     t0 = _time.perf_counter()
     if _partition_first(ctx):
-        perm = partitioned_sort_permutation(reps, buckets, num_buckets)
+        shard_offs = _sharded_tail_offsets(ctx, shard_offs)
+        if shard_offs is not None:
+            perm = sharded_sort_permutation(
+                reps, buckets, num_buckets, shard_offs
+            )
+        else:
+            perm = partitioned_sort_permutation(reps, buckets, num_buckets)
     else:
         perm = sort_permutation(reps, buckets)
     out = buckets[perm], batch.take(perm)
@@ -595,8 +645,16 @@ def _write_bucketed_pipelined(
         partition_by_bucket,
     )
 
-    buckets, reps, batch = _hash_shuffle(ctx, batch, indexed_cols, num_buckets)
+    buckets, reps, batch, shard_offs = _hash_shuffle(
+        ctx, batch, indexed_cols, num_buckets
+    )
     os.makedirs(ctx.index_data_path, exist_ok=True)
+    shard_offs = _sharded_tail_offsets(ctx, shard_offs)
+    if shard_offs is not None:
+        return _write_bucketed_sharded(
+            ctx, buckets, reps, batch, file_idx_offset, use_dict,
+            num_buckets, shard_offs,
+        )
     t0 = _time.perf_counter()
     order, offsets = partition_by_bucket(buckets, num_buckets)
     planes = _order_words_np(reps.astype(np.int64, copy=False))
@@ -622,6 +680,101 @@ def _write_bucketed_pipelined(
             written.append(f.result())
     _stage_add("write", t0)
     return written
+
+
+def _write_bucketed_sharded(
+    ctx,
+    buckets: np.ndarray,
+    reps: np.ndarray,
+    batch: ColumnarBatch,
+    file_idx_offset: int,
+    use_dict,
+    num_buckets: int,
+    shard_offs: np.ndarray,
+) -> List[str]:
+    """Device-local tail of the in-memory sharded build: each mesh
+    shard's post-exchange slice (exactly the buckets it owns) runs the
+    partition-first pipeline — counting scatter, per-bucket key sorts,
+    per-bucket parquet writes with sort/write overlap — CONCURRENTLY
+    with the other shards'. Sort working set and write bandwidth scale
+    with the shard count; nothing serializes through one global
+    permutation.
+
+    Bit-identical files to the single-tail layout: a bucket lives wholly
+    inside one shard slice, slices are contiguous in post-exchange row
+    order, and the per-shard stable sort restricted to a bucket equals
+    the global stable (bucket, keys...) sort restricted to that bucket.
+    The encoding decision (``use_dict``) was computed once by the caller
+    on the shared pre-sort input.
+
+    Stage accounting: "sort"/"write" accumulate per-shard BUSY time
+    (their sum can exceed wall time — the excess is the sharding win);
+    "tail_wall" is the wall time of the whole sharded tail and
+    "tail_shards" the number of concurrent shard tails.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    from hyperspace_tpu.ops.sort import (
+        _order_words_np,
+        bucket_key_sort_runs,
+        partition_by_bucket,
+        shard_tail_plan,
+    )
+
+    t_tail = _time.perf_counter()
+    planes = _order_words_np(reps.astype(np.int64, copy=False))
+    table = batch.to_arrow()
+    shards, threads = shard_tail_plan(shard_offs)
+
+    def run_shard(s: int) -> List[Tuple[int, str]]:
+        lo, hi = int(shard_offs[s]), int(shard_offs[s + 1])
+        t0 = _time.perf_counter()
+        order, offsets = partition_by_bucket(buckets[lo:hi], num_buckets)
+        order += lo  # global row coordinates into planes/table
+        out: List[Tuple[int, str]] = []
+        # one writer thread per shard: bucket i+1 sorts while bucket i
+        # writes, exactly the single-tail pipeline, D of them in flight
+        with ThreadPoolExecutor(max_workers=1) as writer:
+            futures = []
+            for b, final_idx in bucket_key_sort_runs(
+                planes, order, offsets, workers=1, n_threads=threads
+            ):
+                futures.append(
+                    (
+                        b,
+                        writer.submit(
+                            pio.write_bucket_file,
+                            ctx.index_data_path,
+                            b,
+                            file_idx_offset,
+                            table,
+                            final_idx,
+                            use_dict,
+                        ),
+                    )
+                )
+            _stage_add("sort", t0)
+            t0 = _time.perf_counter()
+            out = [(b, f.result()) for b, f in futures]
+        _stage_add("write", t0)
+        return out
+
+    if len(shards) == 1:
+        results = [run_shard(shards[0])]
+    else:
+        with ThreadPoolExecutor(
+            max_workers=len(shards), thread_name_prefix="hs-shardtail"
+        ) as pool:
+            results = list(pool.map(run_shard, shards))
+    with _build_bd_lock:
+        last_build_breakdown["tail_wall"] = (
+            last_build_breakdown.get("tail_wall", 0.0)
+            + _time.perf_counter()
+            - t_tail
+        )
+        last_build_breakdown["tail_shards"] = float(len(shards))
+    # ascending bucket id, matching the single-tail writers' output order
+    return [path for _b, path in sorted(p for r in results for p in r)]
 
 
 def _write_bucketed_streaming(
@@ -686,23 +839,60 @@ def _write_bucketed_streaming(
                     pio.write_table(path, table.take(pa.array(idx)))
                     bucket_parts.setdefault(b, []).append(path)
                 wave_idx += 1
-        # merge: per bucket, read parts, key-sort, write the final file
-        written: List[str] = []
-        for b in sorted(bucket_parts):
+        # merge: per bucket, read parts, key-sort, write the final file.
+        # On a >1-device mesh with the sharded tail on, each shard's
+        # bucket range (bucket % D) merges on its own worker — the
+        # streaming build's waves already sorted per shard (bucketize),
+        # and this keeps the merge tail device-local too.
+        def merge_bucket(b: int) -> List[str]:
             merged = ColumnarBatch.from_arrow(
                 pio.read_table(bucket_parts[b], None)
             )
             perm = sort_permutation(merged.key_reps(indexed_cols))
             merged = merged.take(perm)
-            written.extend(
-                pio.write_bucket_files(
-                    ctx.index_data_path,
-                    np.full(merged.num_rows, b, dtype=np.int32),
-                    merged,
-                    num_buckets,
-                    file_idx_offset,
-                )
+            return pio.write_bucket_files(
+                ctx.index_data_path,
+                np.full(merged.num_rows, b, dtype=np.int32),
+                merged,
+                num_buckets,
+                file_idx_offset,
             )
+
+        ordered = sorted(bucket_parts)
+        D = ctx.mesh.devices.size
+        written: List[str] = []
+        merge_workers = 1
+        if D > 1 and ctx.session.conf.build_sharded_tail and len(ordered) > 1:
+            # The streaming build's contract is bounded peak memory (one
+            # wave + one bucket); concurrent per-shard merges may only
+            # widen that to k buckets when k of the LARGEST fit the wave
+            # budget — estimated from the spilled parts' own footers.
+            biggest = max(
+                sum(per_file_materialized_bytes(bucket_parts[b], "parquet"))
+                for b in ordered
+            )
+            fit = int(budget // max(biggest, 1))
+            merge_workers = max(1, min(D, fit))
+        if merge_workers > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            from hyperspace_tpu.parallel.mesh import bucket_owner_groups
+
+            groups = bucket_owner_groups(ordered, D)
+
+            def merge_shard(g: List[int]) -> Dict[int, List[str]]:
+                return {ordered[i]: merge_bucket(ordered[i]) for i in g}
+
+            with ThreadPoolExecutor(
+                max_workers=merge_workers, thread_name_prefix="hs-shardmerge"
+            ) as pool:
+                merged_maps = list(pool.map(merge_shard, groups))
+            by_bucket = {b: fs for m in merged_maps for b, fs in m.items()}
+            for b in ordered:
+                written.extend(by_bucket[b])
+        else:
+            for b in ordered:
+                written.extend(merge_bucket(b))
         return written
     finally:
         shutil.rmtree(spill_root, ignore_errors=True)

@@ -115,20 +115,22 @@ _SUPERSEDED_TTL_S = 7 * 24 * 3600.0
 def _cleanup_superseded(keep: str) -> None:
     """Drop STALE artifacts of other source revisions (the cache is keyed
     by a source hash, so every edit would otherwise strand one .so
-    forever — a real leak on shared filesystems and baked images). Only
-    artifacts older than ``_SUPERSEDED_TTL_S`` are removed: a younger
-    artifact likely belongs to another live checkout sharing this cache
-    dir (two checkouts deleting each other's .so recompile forever)."""
+    forever — a real leak on shared filesystems and baked images) and
+    ORPHANED ``.tmp.<pid>`` compile scratch files (a SIGKILLed g++ leaves
+    one behind; nothing else ever reclaims it). Only artifacts older
+    than ``_SUPERSEDED_TTL_S`` are removed: a younger .so likely belongs
+    to another live checkout sharing this cache dir (two checkouts
+    deleting each other's .so recompile forever), and a younger tmp may
+    be another process mid-compile — unlinking its tmp would fail its
+    ``os.replace`` and latch a bogus .failed marker. A week-old tmp is
+    unambiguously an orphan, whatever revision it belongs to."""
     pattern = os.path.join(os.path.dirname(keep), "_hs_native_*")
     now = _time.time()
     for old in glob.glob(pattern):
-        # Never touch .tmp.<pid> files: on a shared filesystem another
-        # process may be mid-compile of a DIFFERENT source revision, and
-        # unlinking its tmp would fail its os.replace and latch a bogus
-        # .failed marker. Orphaned tmps (SIGKILL) are gitignored noise.
-        if ".tmp." in os.path.basename(old):
-            continue
-        if old.startswith(keep):
+        # tmp files are swept even for the CURRENT revision (orphans of
+        # this .so's own past compiles); live artifacts of the current
+        # revision (.so, .failed) are never touched
+        if ".tmp." not in os.path.basename(old) and old.startswith(keep):
             continue
         try:
             if now - os.path.getmtime(old) >= _SUPERSEDED_TTL_S:
@@ -391,6 +393,11 @@ def load(wait: bool = True):
             os.utime(path)
         except OSError:
             pass
+        # sweep stale artifacts on every successful load, not only after
+        # a compile: a steady-state process never compiles, so orphaned
+        # .tmp.<pid> files and superseded revisions would otherwise
+        # outlive every producer
+        _cleanup_superseded(path)
         _lib = lib
         return _lib
     finally:

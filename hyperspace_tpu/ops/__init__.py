@@ -34,18 +34,52 @@ import jax
 
 jax.config.update("jax_enable_x64", True)
 
+def _machine_cache_tag() -> str:
+    """Short fingerprint of THIS machine's CPU feature set (plus arch).
+
+    The persistent cache stores XLA:CPU AOT results compiled against the
+    build machine's exact feature flags; loading an entry on a host with
+    a different feature set makes ``cpu_aot_loader`` emit a wall of
+    machine-feature-mismatch warnings per entry (and risks SIGILL).
+    Shared cache dirs (home on NFS, baked images, heterogeneous fleets)
+    hit this constantly — scoping the cache per machine fingerprint
+    makes every entry loadable by construction. Same-hardware hosts
+    still share (same flags -> same tag)."""
+    import hashlib
+    import platform
+
+    feats = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    feats = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        feats = platform.processor() or platform.machine()
+
+    return hashlib.sha256(
+        (platform.machine() + "|" + feats).encode()
+    ).hexdigest()[:12]
+
+
 # Persistent XLA compilation cache. TPU sort kernels take 40-80s to
 # compile while executing in milliseconds; caching them on disk makes every
 # process after the first pay only dispatch cost. Opt out (or relocate)
 # via HYPERSPACE_JAX_CACHE_DIR; the exact value "off" disables (a
-# directory literally named off/OFF still works as a path).
+# directory literally named off/OFF still works as a path). The cache is
+# scoped per machine fingerprint (see _machine_cache_tag) so entries are
+# always feature-compatible with the loading host.
 _cache_dir = os.environ.get(
     "HYPERSPACE_JAX_CACHE_DIR",
     os.path.join(os.path.expanduser("~"), ".cache", "hyperspace_tpu", "jax"),
 )
 if _cache_dir != "off":
     try:
-        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(_cache_dir, "m-" + _machine_cache_tag()),
+        )
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
     # older jax without the knobs (exception type varies by version):
     # in-memory cache only

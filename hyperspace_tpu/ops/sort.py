@@ -213,7 +213,13 @@ def _sort_pool_plan(n_buckets: int) -> tuple[int, int]:
     return workers, max(1, budget // workers)
 
 
-def bucket_key_sort_runs(planes: np.ndarray, order: np.ndarray, offsets: np.ndarray):
+def bucket_key_sort_runs(
+    planes: np.ndarray,
+    order: np.ndarray,
+    offsets: np.ndarray,
+    workers: int | None = None,
+    n_threads: int | None = None,
+):
     """Per-bucket stable key sorts over a partitioned order — yields
     ``(bucket, final_indices)`` in ascending bucket id as each bucket's
     sort completes, running the sorts on a thread pool.
@@ -224,6 +230,11 @@ def bucket_key_sort_runs(planes: np.ndarray, order: np.ndarray, offsets: np.ndar
     plane (constant within a bucket). Ties keep ``idx`` order, and
     ``idx`` is ascending, so ``idx[perm]`` reproduces exactly the global
     stable lexsort by (bucket, keys...) restricted to bucket ``b``.
+
+    ``workers``/``n_threads`` override the core-budget split — the
+    sharded tail runs one of these loops PER SHARD concurrently
+    (``workers=1``, the shard thread is the concurrency unit) and hands
+    each shard a slice of the native-sort thread budget.
     """
     from concurrent.futures import ThreadPoolExecutor
 
@@ -232,7 +243,10 @@ def bucket_key_sort_runs(planes: np.ndarray, order: np.ndarray, offsets: np.ndar
     ]
     if not nonempty:
         return
-    workers, threads = _sort_pool_plan(len(nonempty))
+    if workers is None:
+        workers, threads = _sort_pool_plan(len(nonempty))
+    else:
+        threads = max(1, n_threads or 1)
 
     def sort_one(b: int) -> np.ndarray:
         idx = order[offsets[b] : offsets[b + 1]]
@@ -267,6 +281,72 @@ def partitioned_sort_permutation(
     out = np.empty(len(order), dtype=np.int64)
     for b, final_idx in bucket_key_sort_runs(planes, order, offsets):
         out[offsets[b] : offsets[b + 1]] = final_idx
+    return out
+
+
+def shard_tail_plan(shard_offsets: np.ndarray) -> tuple[list, int]:
+    """(non-empty shards, native threads per shard) for the sharded
+    build tail: shards are the concurrency unit, each gets an equal
+    slice of the core budget for its in-shard native sorts."""
+    from hyperspace_tpu import native
+
+    shards = [
+        s
+        for s in range(len(shard_offsets) - 1)
+        if shard_offsets[s + 1] > shard_offsets[s]
+    ]
+    budget = max(1, min(native._cores(), 16))
+    return shards, max(1, budget // max(len(shards), 1))
+
+
+def sharded_sort_permutation(
+    key_reps: np.ndarray,
+    bucket: np.ndarray,
+    num_buckets: int,
+    shard_offsets: np.ndarray,
+) -> np.ndarray:
+    """The device-local twin of :func:`partitioned_sort_permutation`:
+    each mesh shard's post-exchange slice (``shard_offsets[s] :
+    shard_offsets[s+1]``, exactly the buckets that shard owns) runs its
+    own counting scatter + per-bucket key sorts CONCURRENTLY with the
+    other shards', so sort working set and thread occupancy scale with
+    the shard count instead of serializing through one permutation over
+    the full batch.
+
+    Output row order is shard-major (shard 0's buckets ascending, then
+    shard 1's, …), NOT the globally bucket-ascending order of the
+    single-tail sort — but every bucket lives wholly inside one shard
+    slice, so each bucket's rows and their stable key-sorted order are
+    bit-identical to the global sort restricted to that bucket, which is
+    the only order the bucketed writers observe (one file per bucket).
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    planes = _order_words_np(key_reps.astype(np.int64, copy=False))
+    n = int(shard_offsets[-1])
+    out = np.empty(n, dtype=np.int64)
+    shards, threads = shard_tail_plan(shard_offsets)
+    if not shards:
+        return out
+
+    def run_shard(s: int) -> None:
+        lo, hi = int(shard_offsets[s]), int(shard_offsets[s + 1])
+        order, offsets = partition_by_bucket(bucket[lo:hi], num_buckets)
+        order += lo  # global row coordinates for the planes gather
+        pos = lo
+        for _b, final_idx in bucket_key_sort_runs(
+            planes, order, offsets, workers=1, n_threads=threads
+        ):
+            out[pos : pos + len(final_idx)] = final_idx
+            pos += len(final_idx)
+
+    if len(shards) == 1:
+        run_shard(shards[0])
+        return out
+    with ThreadPoolExecutor(
+        max_workers=len(shards), thread_name_prefix="hs-shardsort"
+    ) as pool:
+        list(pool.map(run_shard, shards))
     return out
 
 

@@ -71,6 +71,35 @@ def initialize_distributed(
     _DISTRIBUTED_INITIALIZED = True
 
 
+def bucket_owner_groups(
+    bucket_ids: Sequence[int], num_shards: int, min_tasks: int = 1
+):
+    """Index groups of ``bucket_ids`` by owner shard — THE bucket
+    ownership layout (``bucket % num_shards``, the same routing the
+    build shuffle uses), shared by the sharded build/serve tails so the
+    mapping lives in one place. Returns a list of position lists, one
+    per occupied shard, ascending shard id.
+
+    ``min_tasks`` splits large groups WITHIN a shard (chunks never cross
+    an ownership boundary) until at least that many task units exist —
+    a 2-shard mesh must not cap a thread fan-out below the caller's
+    worker budget when there are buckets to spare. Callers always
+    collect results per bucket position, so any grouping yields
+    identical output; only scheduling changes."""
+    groups: dict = {}
+    for i, b in enumerate(bucket_ids):
+        groups.setdefault(int(b) % num_shards, []).append(i)
+    ordered = [groups[s] for s in sorted(groups)]
+    if min_tasks <= len(ordered):
+        return ordered
+    chunks_per = -(-min_tasks // len(ordered))  # ceil
+    out = []
+    for g in ordered:
+        size = -(-len(g) // chunks_per)
+        out.extend(g[i : i + size] for i in range(0, len(g), size))
+    return out
+
+
 def default_mesh(devices: Optional[Sequence] = None) -> jax.sharding.Mesh:
     """The flat data-plane mesh: ONE shard axis over every addressable
     device. ``jax.devices()`` is process-major, so the axis is

@@ -23,6 +23,7 @@ driven by ``hyperspace.index.build.memoryBudgetBytes``.)
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Dict, List, Sequence, Tuple
 
 import jax
@@ -30,6 +31,15 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
+
+_log = logging.getLogger("hyperspace_tpu.shuffle")
+
+# Telemetry of the most recent ``bucket_shuffle`` (host-observed, set by
+# ``_exchange_cap``): exchange capacity and the per-(shard, peer)
+# send-count skew. The exchange pads every (shard, peer) slot to the MAX
+# count, so one hot bucket inflates exchange memory by ~skew× silently —
+# the build copies this into its telemetry and the bench publishes it.
+last_shuffle_stats: Dict[str, float] = {}
 
 from hyperspace_tpu.ops.hash import hash_columns
 from hyperspace_tpu.parallel.mesh import SHARD_AXIS
@@ -114,13 +124,20 @@ def bucket_shuffle(
     payloads: Sequence[np.ndarray],
     num_buckets: int,
     seed: int = 42,
-) -> Tuple[np.ndarray, List[np.ndarray]]:
+    with_shard_offsets: bool = False,
+):
     """Host entry: shuffle rows into bucket-contiguous order across the mesh.
 
     Returns ``(bucket_ids, payload_cols)`` with all rows grouped by bucket
     (global order: all rows of buckets owned by shard 0, then shard 1, …;
     within a shard, ascending bucket id). The caller does the final
     within-bucket key sort (``ops/sort.py``) before writing.
+
+    ``with_shard_offsets=True`` additionally returns the ``[D+1]`` row
+    offsets of each shard's compacted slice — rows
+    ``offsets[s]:offsets[s+1]`` are exactly the buckets shard ``s`` owns
+    (``bucket % D == s``), the handle the sharded build/serve tail needs
+    to keep bucket ownership device-local past the exchange.
     """
     from hyperspace_tpu.ops import pad_len
 
@@ -156,7 +173,16 @@ def bucket_shuffle(
             f"bucket shuffle lost rows: sent {n}, received {len(keep)} "
             f"(cap={cap}) — host/device hash divergence?"
         )
-    return bucket[keep], [np.asarray(c)[keep] for c in cols]
+    out = bucket[keep], [np.asarray(c)[keep] for c in cols]
+    if not with_shard_offsets:
+        return out
+    # shard s's post-exchange slice is rows [s*D*cap, (s+1)*D*cap) of the
+    # flat output; its compacted extent is the valid count per slice
+    per_shard = vmask.reshape(D, D * cap).sum(axis=1)
+    offsets = np.concatenate(
+        [np.zeros(1, dtype=np.int64), np.cumsum(per_shard, dtype=np.int64)]
+    )
+    return out[0], out[1], offsets
 
 
 def _exchange_cap(
@@ -175,6 +201,11 @@ def _exchange_cap(
     from hyperspace_tpu.ops import pad_len
     from hyperspace_tpu.ops.hash import bucket_ids_host
 
+    from hyperspace_tpu.constants import (
+        BUILD_SHUFFLE_SKEW_WARN_MIN_ROWS,
+        BUILD_SHUFFLE_SKEW_WARN_RATIO,
+    )
+
     total = key_reps.shape[1]
     n_local = total // D
     counts = np.zeros((D, D), dtype=np.int64)
@@ -185,4 +216,33 @@ def _exchange_cap(
         v = valid[start:end]
         np.add.at(counts, (shard[v], dest[v]), 1)
     max_count = max(int(counts.max()), 1)
-    return min(pad_len(max_count), n_local)  # never larger than a shard
+    cap = min(pad_len(max_count), n_local)  # never larger than a shard
+    # skew telemetry: the [D, cap] exchange buffers pad every slot to the
+    # hottest (shard, peer) count, so memory = skew × the balanced cost
+    mean_count = float(counts.mean())
+    skew = max_count / mean_count if mean_count > 0 else 1.0
+    last_shuffle_stats.clear()
+    last_shuffle_stats.update(
+        {
+            "devices": float(D),
+            "cap": float(cap),
+            "max_peer_count": float(max_count),
+            "mean_peer_count": round(mean_count, 1),
+            "skew_ratio": round(skew, 2),
+        }
+    )
+    if (
+        skew > BUILD_SHUFFLE_SKEW_WARN_RATIO
+        and max_count >= BUILD_SHUFFLE_SKEW_WARN_MIN_ROWS
+    ):
+        _log.warning(
+            "bucket shuffle skew: hottest (shard, peer) slot carries "
+            "%.1fx the mean row count (max=%d, mean=%.0f, D=%d) — the "
+            "padded exchange buffers inflate accordingly; consider more "
+            "buckets or less skewed key columns",
+            skew,
+            max_count,
+            mean_count,
+            D,
+        )
+    return cap

@@ -5,6 +5,8 @@
 # about a minute, so the pipelined code paths run on every CI pass —
 # not only in the 4M-row chip benches. The numbers are NOT meaningful
 # (tiny rows, host backend); the exit code and the single JSON line are.
+# An 8-device CPU mesh is forced so the mesh ladder rows run the sharded
+# build/serve tail (shard_map all-to-all + per-shard sort/write/merge).
 #
 # Usage: scripts/bench_smoke.sh  [rows]   (default 100000)
 set -euo pipefail
@@ -15,13 +17,18 @@ if [ "$ROWS" -gt 100000 ]; then
     ROWS=100000
 fi
 OUT=$(JAX_PLATFORMS=cpu \
+HS_BENCH_FORCE_CPU_DEVICES=8 \
 HS_BENCH_ROWS="$ROWS" \
 HS_BENCH_REPS="${HS_BENCH_REPS:-2}" \
 HS_BENCH_LADDER="$ROWS" \
+HS_BENCH_MESH="${HS_BENCH_MESH:-1,8}" \
+HS_BENCH_MESH_ROWS="$ROWS" \
 python bench.py)
 echo "$OUT"
 # the pruned filter path must actually have run: the z-order row's
-# zone-map telemetry is part of the bench JSON contract
+# zone-map telemetry is part of the bench JSON contract — and so are the
+# mesh ladder rows (a >1-device rung must have run the sharded tail and
+# recorded shuffle skew telemetry)
 echo "$OUT" | python -c '
 import json, sys
 d = json.loads(sys.stdin.read())
@@ -29,5 +36,17 @@ zp = d["zorder_prune"]
 assert zp["row_groups_total"] > 0, "rangeprune telemetry missing"
 assert "zonemap_hit_rate" in zp, zp
 assert "zorder_range_pruneoff_p50_ms" in d, "prune A/B leg missing"
+mesh = d["mesh_ladder"]
+assert mesh, "mesh ladder rows missing"
+multi = [r for r in mesh if r["devices"] > 1]
+assert multi, f"no >1-device mesh rung ran: {mesh}"
+for r in multi:
+    assert r["build_rows_per_sec"] > 0, r
+    assert r["build_stage_seconds"].get("tail_shards", 0) > 1, (
+        "sharded tail did not run per shard: %r" % r
+    )
+    assert "skew_ratio" in {k.replace("shuffle_", "") for k in r["shuffle"]}, r
 print("bench_smoke: rangeprune telemetry ok:", zp, file=sys.stderr)
+print("bench_smoke: mesh ladder ok:", multi[-1]["build_stage_seconds"],
+      multi[-1]["shuffle"], file=sys.stderr)
 '

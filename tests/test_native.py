@@ -465,14 +465,19 @@ class TestCleanupSupersededTTL:
         stale_failed = tmp_path / "_hs_native_dddd.so.failed"
         stale_failed.write_text("boom")
         os.utime(stale_failed, (old, old))
-        tmp_marker = tmp_path / "_hs_native_eeee.so.tmp.123"
-        tmp_marker.write_bytes(b"x")
-        os.utime(tmp_marker, (old, old))
+        stale_tmp = tmp_path / "_hs_native_eeee.so.tmp.123"
+        stale_tmp.write_bytes(b"x")
+        os.utime(stale_tmp, (old, old))
+        young_tmp = tmp_path / "_hs_native_ffff.so.tmp.456"
+        young_tmp.write_bytes(b"x")
         native._cleanup_superseded(keep)
         assert young.exists()  # another live checkout's kernel
         assert not stale.exists()  # genuinely abandoned revision
         assert not stale_failed.exists()
-        assert tmp_marker.exists()  # mid-compile files are never touched
+        # a week-old tmp is an orphan (SIGKILLed compile), not a compile
+        # in progress — swept; a young tmp may be mid-compile — kept
+        assert not stale_tmp.exists()
+        assert young_tmp.exists()
 
     def test_load_refreshes_so_mtime(self, monkeypatch):
         """A revision that only ever LOADS its cached .so must keep a

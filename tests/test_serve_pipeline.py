@@ -260,7 +260,7 @@ class TestScanPrepareOverlap:
 
         real_prepare = je.prepare_join_side_pipelined
 
-        def traced_prepare(items_stream, key_cols):
+        def traced_prepare(items_stream, key_cols, **kw):
             def trace(fetch):
                 def run():
                     batch = fetch()
@@ -273,7 +273,7 @@ class TestScanPrepareOverlap:
                 return run
 
             return real_prepare(
-                [(b, trace(f)) for b, f in items_stream], key_cols
+                [(b, trace(f)) for b, f in items_stream], key_cols, **kw
             )
 
         monkeypatch.setattr(ex.pio, "read_table", slow_read)
