@@ -196,6 +196,100 @@ def main() -> None:
             f"({filter_raw['p50'] / filter_idx['p50']:.2f}x)"
         )
 
+        # --- fused serve-pipeline compiler (filter→aggregate;
+        # docs/serve-compiler.md): interleaved A/B of the fused native
+        # pass vs the interpreted chain
+        # (hyperspace.serve.fusedpipeline.enabled on/off within one
+        # process, so page-cache/allocator drift hits both legs). The
+        # dispatch threshold is pinned low FOR THIS SECTION only: the
+        # A/B measures fused-vs-interpreted, not the calibrated
+        # crossover (which would route tiny smoke runs to the
+        # interpreted chain on both legs and measure nothing).
+        from hyperspace_tpu import functions as hsf
+        from hyperspace_tpu.execution import pipeline_compiler as _pc
+
+        _fused_min_saved = _pc._NATIVE_FUSED_PIPELINE_MIN_ROWS
+        _pc._NATIVE_FUSED_PIPELINE_MIN_ROWS = 1 << 10
+        agg_lo = n_orders // 4
+        agg_hi = agg_lo + max(n_orders // 8, 1)
+
+        def q_fagg(df):
+            return df.filter(
+                (df["l_orderkey"] >= agg_lo) & (df["l_orderkey"] < agg_hi)
+            ).agg(
+                hsf.count().alias("n"),
+                hsf.sum("l_extendedprice").alias("rev"),
+                hsf.min("l_quantity").alias("qmin"),
+                hsf.max("l_quantity").alias("qmax"),
+            )
+
+        def q_gagg(df):
+            return (
+                df.filter(
+                    (df["l_orderkey"] >= agg_lo) & (df["l_orderkey"] < agg_hi)
+                )
+                .group_by("l_quantity")
+                .agg(
+                    hsf.count().alias("n"),
+                    hsf.sum("l_extendedprice").alias("rev"),
+                )
+            )
+
+        def _ab_stats(ts):
+            q1, med, q3 = np.percentile(ts, [25, 50, 75])
+            return {"p50": float(med), "iqr": float(q3 - q1), "n": len(ts)}
+
+        def ab_fused(q):
+            # reset the telemetry BEFORE the warm run: a silent fused
+            # fallback must read as fused_ran=False, not inherit the
+            # previous query's stats (the smoke gate depends on this)
+            _pc.last_fused_stats = {}
+            q(items).collect()  # warm (and capture the fused telemetry)
+            stats = {
+                k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in _pc.last_fused_stats.items()
+            }
+            t_on, t_off = [], []
+            rows_on = rows_off = None
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                rows_on = q(items).collect().num_rows
+                t_on.append(time.perf_counter() - t0)
+                session.conf.set(C.SERVE_FUSEDPIPELINE_ENABLED, False)
+                t0 = time.perf_counter()
+                rows_off = q(items).collect().num_rows
+                t_off.append(time.perf_counter() - t0)
+                session.conf.unset(C.SERVE_FUSEDPIPELINE_ENABLED)
+            assert rows_on == rows_off, (rows_on, rows_off)
+            return _ab_stats(t_on), _ab_stats(t_off), stats
+
+        session.enable_hyperspace()
+        plan = q_fagg(items).explain()
+        if "Hyperspace(Type: CI" not in plan:
+            log(f"WARNING: filter-aggregate not index-served:\n{plan}")
+        fagg_on, fagg_off, fagg_stats = ab_fused(q_fagg)
+        gagg_on, gagg_off, gagg_stats = ab_fused(q_gagg)
+        _pc._NATIVE_FUSED_PIPELINE_MIN_ROWS = _fused_min_saved
+        session.disable_hyperspace()
+        log(
+            "filter→aggregate p50: fused "
+            f"{fagg_on['p50'] * 1e3:.1f}ms vs interpreted "
+            f"{fagg_off['p50'] * 1e3:.1f}ms "
+            f"({fagg_off['p50'] / fagg_on['p50']:.2f}x); "
+            f"scanned {fagg_stats.get('rows_scanned', 0):,} rows, "
+            f"passed {fagg_stats.get('rows_passed', 0):,}, fused "
+            f"materialized {fagg_stats.get('rows_materialized', 0):,} "
+            "(interpreted materializes every passing row per column)"
+        )
+        log(
+            "grouped-aggregate p50: fused "
+            f"{gagg_on['p50'] * 1e3:.1f}ms vs interpreted "
+            f"{gagg_off['p50'] * 1e3:.1f}ms "
+            f"({gagg_off['p50'] / gagg_on['p50']:.2f}x); "
+            f"{gagg_stats.get('groups', 0)} groups over "
+            f"{gagg_stats.get('rows_passed', 0):,} passing rows"
+        )
+
         # --- indexed join (JoinIndexRule, co-bucketed, shuffle-free)
         def q_join(o, i):
             return o.join(i, on=o["o_orderkey"] == i["l_orderkey"]).select(
@@ -724,6 +818,28 @@ def main() -> None:
                     "filter_cached_speedup": round(
                         filter_raw["p50"] / filter_cached["p50"], 3
                     ),
+                    "filter_agg": {
+                        "fused_p50_ms": ms(fagg_on),
+                        "fused_iqr_ms": iqr_ms(fagg_on),
+                        "interp_p50_ms": ms(fagg_off),
+                        "interp_iqr_ms": iqr_ms(fagg_off),
+                        "fused_speedup": round(
+                            fagg_off["p50"] / fagg_on["p50"], 3
+                        ),
+                        "fused_ran": fagg_stats.get("mode") == "agg",
+                        "stats": fagg_stats,
+                    },
+                    "grouped_agg": {
+                        "fused_p50_ms": ms(gagg_on),
+                        "fused_iqr_ms": iqr_ms(gagg_on),
+                        "interp_p50_ms": ms(gagg_off),
+                        "interp_iqr_ms": iqr_ms(gagg_off),
+                        "fused_speedup": round(
+                            gagg_off["p50"] / gagg_on["p50"], 3
+                        ),
+                        "fused_ran": gagg_stats.get("mode") == "agg",
+                        "stats": gagg_stats,
+                    },
                     "join_indexed_p50_ms": ms(join_idx),
                     "join_indexed_iqr_ms": iqr_ms(join_idx),
                     "join_unindexed_p50_ms": ms(join_raw),

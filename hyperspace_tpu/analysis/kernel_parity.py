@@ -14,7 +14,12 @@ reference engine). This checker turns that contract into lint:
   or a dotted path into the package whose target function exists
   (HS103);
 * at least one file under ``tests/`` must reference the export or its
-  wrapper, so the parity claim is actually exercised (HS104).
+  wrapper, so the parity claim is actually exercised (HS104);
+* a FUSED-PIPELINE export (``hs_fused_*``) must register an in-package
+  interpreted twin — the op chain the fused pass replaces — not a
+  ``numpy.*`` single op: a single-op twin cannot witness whole-pipeline
+  parity (HS105). This is the KERNEL_TWINS doctrine generalized from
+  kernels to pipelines (docs/serve-compiler.md).
 """
 
 from __future__ import annotations
@@ -31,6 +36,7 @@ RULES = {
     "HS102": "KERNEL_TWINS entry names a symbol not exported by hs_native.cpp",
     "HS103": "KERNEL_TWINS wrapper or numpy twin does not resolve",
     "HS104": "native kernel has no differential test referencing it",
+    "HS105": "fused-pipeline export needs an in-package interpreted twin",
 }
 
 # A C export: one or more type tokens, then an hs_-prefixed identifier,
@@ -154,6 +160,20 @@ def check(project: Project) -> List[Finding]:
                     reg_line,
                     f"{export}: numpy twin {twin!r} does not resolve "
                     "(expected numpy.<fn> or a dotted in-package function)",
+                )
+            )
+        if export.startswith("hs_fused_") and twin.startswith("numpy."):
+            # fused pipelines replace a whole op CHAIN: the registered
+            # twin must be the in-package interpreted chain the
+            # differential test runs, not a numpy single op
+            findings.append(
+                Finding(
+                    "HS105",
+                    native_sf.rel_path,
+                    reg_line,
+                    f"{export}: fused-pipeline exports must register an "
+                    f"in-package interpreted twin, got {twin!r} — a numpy "
+                    "single-op twin cannot witness whole-pipeline parity",
                 )
             )
         if tests and not any(

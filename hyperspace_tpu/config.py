@@ -201,6 +201,16 @@ class Config:
         )
 
     @property
+    def serve_fusedpipeline_enabled(self) -> bool:
+        """Fused serve-pipeline compiler: Filter→Project→Aggregate over a
+        pruned index scan runs as one native pass per row-group chunk
+        (bit-identical to the interpreted chain; False = old path)."""
+        return self.get_bool(
+            C.SERVE_FUSEDPIPELINE_ENABLED,
+            C.SERVE_FUSEDPIPELINE_ENABLED_DEFAULT,
+        )
+
+    @property
     def default_supported_formats(self) -> set:
         raw = self.get_str(
             C.DEFAULT_SUPPORTED_FORMATS, C.DEFAULT_SUPPORTED_FORMATS_DEFAULT

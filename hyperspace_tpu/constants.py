@@ -242,3 +242,23 @@ SERVE_RANGEPRUNE_ENABLED_DEFAULT = True
 # the flag exists for A/B timing and as an escape hatch.
 SERVE_PIPELINE_ENABLED = "hyperspace.serve.pipeline.enabled"
 SERVE_PIPELINE_ENABLED_DEFAULT = True
+
+# Fused serve-pipeline compiler (execution/pipeline_compiler.py, see
+# docs/serve-compiler.md): a Filter→Project→Aggregate (or plain
+# Filter→Project) subtree over a pruned index scan is lowered into one
+# fused native pass per surviving row-group chunk — predicate, projection
+# and partial COUNT/SUM/MIN/MAX (grouped or not) in a single sweep, no
+# materialized mask/gather/filtered-batch intermediates, partials merged
+# at the edge. Bit-identical to the interpreted chain (differential-
+# tested); the flag restores the old op-at-a-time path for A/B timing
+# and as an escape hatch.
+SERVE_FUSEDPIPELINE_ENABLED = "hyperspace.serve.fusedpipeline.enabled"
+SERVE_FUSEDPIPELINE_ENABLED_DEFAULT = True
+
+# FALLBACK default for the fused-pipeline dispatch crossover: at/above
+# this many scanned rows the fused native pass runs; below it the
+# interpreted chain (numpy twins) wins on kernel-call overhead. The
+# effective value comes from the per-machine calibration probe
+# (native/calibrate.py, native_fused_pipeline_min_rows); this constant
+# is the probe-failure fallback, like every other dispatch threshold.
+NATIVE_FUSED_PIPELINE_MIN_ROWS_DEFAULT = 1 << 15

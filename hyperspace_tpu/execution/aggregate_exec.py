@@ -104,6 +104,55 @@ def _string_minmax(
     )
 
 
+# -- per-spec finalization ---------------------------------------------------
+# Shared by this interpreted engine and the fused serve-pipeline compiler
+# (execution/pipeline_compiler.py): the fused native pass produces the
+# same raw reductions (counts / sums / min-max accumulators) and runs the
+# IDENTICAL finalization, so output columns (types, zero-fills, validity
+# presence) cannot diverge between the two paths.
+
+
+def finalize_count(out_type, counts: np.ndarray) -> Column:
+    return Column("numeric", out_type, values=counts)
+
+
+def finalize_minmax(out_type, red: np.ndarray, counts: np.ndarray, vals_dtype) -> Column:
+    """``red`` = raw per-group reduction (NaN rules already applied for
+    floats), ``counts`` = per-group count of VALID input rows."""
+    has = counts > 0
+    red = red.astype(vals_dtype, copy=False)
+    return Column(
+        "numeric",
+        out_type,
+        values=np.where(has, red, np.zeros_like(red)),
+        validity=None if has.all() else has,
+    )
+
+
+def finalize_sum(out_type, sums: np.ndarray, counts: np.ndarray) -> Column:
+    has = counts > 0
+    target = np.float64 if pa.types.is_floating(out_type) else np.int64
+    sums = sums.astype(target, copy=False)
+    return Column(
+        "numeric",
+        out_type,
+        values=np.where(has, sums, np.zeros_like(sums)),
+        validity=None if has.all() else has,
+    )
+
+
+def finalize_avg(out_type, sums: np.ndarray, counts: np.ndarray) -> Column:
+    has = counts > 0
+    with np.errstate(invalid="ignore", divide="ignore"):
+        avg = sums.astype(np.float64) / np.maximum(counts, 1)
+    return Column(
+        "numeric",
+        out_type,
+        values=np.where(has, avg, 0.0),
+        validity=None if has.all() else has,
+    )
+
+
 def execute_aggregate(
     batch: ColumnarBatch,
     group_by: List[str],
@@ -129,7 +178,7 @@ def execute_aggregate(
                 counts = agg_ops.segment_count(
                     gid, _valid_mask(col), n, num_groups
                 )
-            out[spec.name] = Column("numeric", out_type, values=counts)
+            out[spec.name] = finalize_count(out_type, counts)
             continue
 
         col = batch.column(spec.column)
@@ -143,37 +192,15 @@ def execute_aggregate(
             valid = _valid_mask(col)
             red = agg_ops.segment_minmax(gid, vals, valid, num_groups, spec.func)
             counts = agg_ops.segment_count(gid, valid, n, num_groups)
-            has = counts > 0
-            red = red.astype(vals.dtype, copy=False)
-            out[spec.name] = Column(
-                "numeric",
-                out_type,
-                values=np.where(has, red, np.zeros_like(red)),
-                validity=None if has.all() else has,
-            )
+            out[spec.name] = finalize_minmax(out_type, red, counts, vals.dtype)
             continue
 
         # sum / avg
         vals = _numeric_values(col, spec)
         valid = _valid_mask(col)
         sums, counts = agg_ops.segment_sum_count(gid, vals, valid, num_groups)
-        has = counts > 0
         if spec.func == "sum":
-            target = np.float64 if pa.types.is_floating(out_type) else np.int64
-            sums = sums.astype(target, copy=False)
-            out[spec.name] = Column(
-                "numeric",
-                out_type,
-                values=np.where(has, sums, np.zeros_like(sums)),
-                validity=None if has.all() else has,
-            )
+            out[spec.name] = finalize_sum(out_type, sums, counts)
         else:  # avg
-            with np.errstate(invalid="ignore", divide="ignore"):
-                avg = sums.astype(np.float64) / np.maximum(counts, 1)
-            out[spec.name] = Column(
-                "numeric",
-                out_type,
-                values=np.where(has, avg, 0.0),
-                validity=None if has.all() else has,
-            )
+            out[spec.name] = finalize_avg(out_type, sums, counts)
     return ColumnarBatch(out)

@@ -57,6 +57,18 @@ def _exec(plan: LogicalPlan, needed: Set[str], session) -> ColumnarBatch:
             )
         else:
             batch = _exec(child, child_needed, session)
+        if isinstance(child, Scan) and _fused_pipeline_on(session):
+            # fused Filter(→Project) lowering (docs/serve-compiler.md):
+            # one native pass computes the conjunct mask AND compacts
+            # the passing indices — bit-identical to filter(mask),
+            # which IS take(nonzero(mask))
+            from hyperspace_tpu.execution.pipeline_compiler import (
+                fused_filter_batch,
+            )
+
+            fused = fused_filter_batch(plan.condition, batch, session)
+            if fused is not None:
+                return fused
         return batch.filter(_filter_mask(plan.condition, batch, session))
     if isinstance(plan, Project):
         batch = _exec(plan.child, set(plan.columns), session)
@@ -69,6 +81,18 @@ def _exec(plan: LogicalPlan, needed: Set[str], session) -> ColumnarBatch:
     if isinstance(plan, Join):
         return _exec_join(plan, needed, session)
     if isinstance(plan, Aggregate):
+        from hyperspace_tpu.execution.pipeline_compiler import (
+            try_fused_aggregate,
+        )
+
+        # fused serve-pipeline compiler (docs/serve-compiler.md): a
+        # Filter(→Project)→Aggregate subtree over a pruned index scan
+        # runs as one fused native pass per row-group chunk — predicate,
+        # grouping and partial aggregates in a single sweep, partials
+        # merged at the edge; bit-identical to the chain below
+        fused = try_fused_aggregate(plan, session)
+        if fused is not None:
+            return fused
         batch = _exec(plan.child, plan.input_columns, session)
         from hyperspace_tpu.execution.aggregate_exec import execute_aggregate
 
@@ -700,6 +724,16 @@ def _bucket_ids_of_files(files) -> tuple:
     from hyperspace_tpu.io.parquet import bucket_id_of_file
 
     return tuple(bucket_id_of_file(f) for f in files)
+
+
+def _fused_pipeline_on(session) -> bool:
+    """Fused serve-pipeline compiler
+    (``hyperspace.serve.fusedpipeline.enabled``, default on). Applies to
+    sessionless execution too — a pure compute substitution with
+    bit-identical output, like range pruning."""
+    from hyperspace_tpu.execution.pipeline_compiler import fused_pipeline_on
+
+    return fused_pipeline_on(session)
 
 
 def _rangeprune_on(session) -> bool:

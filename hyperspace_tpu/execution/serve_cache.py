@@ -27,7 +27,14 @@ path behaves exactly as before. What gets cached (see
   fingerprints: one for a clean index scan, two for the Hybrid-Scan
   append union (index files + appended source files), so a further
   append or refresh re-keys the entry;
-* ``("bucketed", fp, cols)`` — per-bucket batches for hybrid-scan serves.
+* ``("bucketed", fp, cols)`` — per-bucket batches for hybrid-scan serves;
+* ``("delta", fp, …)`` — the hybrid-scan appended-files compensation,
+  pre-bucketed (``executor._prepare_delta``);
+* ``("zonemap", fp)`` — assembled zone maps for range pruning
+  (``indexes/zonemaps.py``);
+* ``("fusedplan", fp, …)`` — compiled fused-pipeline lowerings
+  (``execution/pipeline_compiler.FusedAggPlan``): the symbolic
+  Filter→Aggregate lowering reused across serves of one index version.
 """
 
 from __future__ import annotations
@@ -116,10 +123,12 @@ class ServeCache:
 
     def evict_kind(self, kind: str) -> int:
         """Drop every entry of one kind (keys are ``(kind, …)`` tuples:
-        "scan" / "bucketed" / "joinside" / "delta"). Returns the number
-        evicted. Operational tooling: lets a serve process (or bench)
-        shed one class of state — e.g. keep the prepared hybrid delta
-        but force joinside re-preparation — without a full clear."""
+        "scan" / "bucketed" / "joinside" / "delta" / "zonemap" /
+        "fusedplan"). Returns the number evicted. Operational tooling:
+        lets a serve process (or bench) shed one class of state — e.g.
+        keep the prepared hybrid delta but force joinside
+        re-preparation, or drop compiled fused-pipeline plans after a
+        config change — without a full clear."""
         with self._lock:
             victims = [
                 k

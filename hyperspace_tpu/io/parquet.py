@@ -228,28 +228,39 @@ def read_table_row_groups(
             f"Row-group reads require a parquet-like format, got {fmt!r}"
         )
     cols = list(columns) if columns else None
-
-    def read_one(path, groups):
-        pf = pq.ParquetFile(path)
-        if groups is None:
-            return pf.read(columns=cols)
-        if len(groups) == 0:
-            return pf.schema_arrow.empty_table().select(
-                cols if cols is not None else pf.schema_arrow.names
-            )
-        return pf.read_row_groups(list(groups), columns=cols)
-
     pairs = list(zip(paths, row_groups))
     if len(pairs) <= 1:
-        tables = [read_one(p, g) for p, g in pairs]
+        tables = [read_file_row_groups(p, g, cols) for p, g in pairs]
     else:
         from hyperspace_tpu.io.scan import scan_pool
 
-        futs = [scan_pool().submit(read_one, p, g) for p, g in pairs]
+        futs = [
+            scan_pool().submit(read_file_row_groups, p, g, cols)
+            for p, g in pairs
+        ]
         tables = [f.result() for f in futs]
     if not tables:
         raise HyperspaceException("No files to read")
     return pa.concat_tables(tables, promote_options="permissive")
+
+
+def read_file_row_groups(
+    path: str, groups: Optional[Sequence[int]], cols: Optional[List[str]]
+) -> pa.Table:
+    """ONE file's row groups (None = the whole file, () = zero rows with
+    the right schema) — the per-file unit of :func:`read_table_row_groups`
+    and of the fused serve-pipeline's chunk stream
+    (``execution/pipeline_compiler._run_chunked``). Kept as the single
+    definition so the fused pass and the interpreted chain can never
+    read different bytes."""
+    pf = pq.ParquetFile(path)
+    if groups is None:
+        return pf.read(columns=cols)
+    if len(groups) == 0:
+        return pf.schema_arrow.empty_table().select(
+            cols if cols is not None else pf.schema_arrow.names
+        )
+    return pf.read_row_groups(list(groups), columns=cols)
 
 
 def list_format_files(root: str, fmt: str = "parquet") -> List[str]:

@@ -74,6 +74,19 @@ KERNEL_TWINS = {
         "range_mask_u8",
         "hyperspace_tpu.ops.filter.range_mask_numpy",
     ),
+    # Fused-pipeline exports (docs/serve-compiler.md): the registered
+    # twin is the INTERPRETED CHAIN the kernel replaces, not a single
+    # numpy op — hslint HS105 enforces an in-package pipeline twin for
+    # every hs_fused_* export, so whole-pipeline parity is what the
+    # differential tests witness.
+    "hs_fused_filter_select": (
+        "fused_filter_select",
+        "hyperspace_tpu.execution.pipeline_compiler.filter_select_interpreted",
+    ),
+    "hs_fused_filter_agg": (
+        "fused_filter_agg",
+        "hyperspace_tpu.execution.pipeline_compiler.interpreted_filter_aggregate",
+    ),
 }
 
 
@@ -363,6 +376,31 @@ def load(wait: bool = True):
                 ctypes.c_int64,
                 _u8p,
                 ctypes.c_int32,
+            ]
+            _vpp = ctypes.POINTER(ctypes.c_void_p)
+            _dp = ctypes.POINTER(ctypes.c_double)
+            lib.hs_fused_filter_select.restype = ctypes.c_int64
+            lib.hs_fused_filter_select.argtypes = [
+                _vpp, _vpp, _u8p, _i64p, _i64p, _dp, _dp,
+                _u8p, _u8p, _u8p, _u8p,
+                ctypes.c_int32, ctypes.c_int64, _i64p, ctypes.c_int32,
+            ]
+            lib.hs_fused_filter_agg.restype = ctypes.c_int64
+            lib.hs_fused_filter_agg.argtypes = [
+                # filter terms
+                _vpp, _vpp, _u8p, _i64p, _i64p, _dp, _dp,
+                _u8p, _u8p, _u8p, _u8p, ctypes.c_int32,
+                # group keys
+                _vpp, _vpp, _u8p, ctypes.c_int32,
+                # aggs
+                _vpp, _vpp, _u8p, ctypes.c_int32,
+                # rows
+                ctypes.c_int64, ctypes.c_int64,
+                # state
+                _i64p, ctypes.c_int64,
+                _i64p, _i64p, _u8p, _i64p, _u8p,
+                _i64p, _dp, _i64p, _i64p,
+                ctypes.c_int64, _i64p, _i64p, ctypes.c_int32,
             ]
             _f64p = ctypes.POINTER(ctypes.c_double)
             lib.hs_gather_i64.restype = ctypes.c_int
@@ -668,6 +706,56 @@ def gather_f64(
     return _gather_64(values, idx)
 
 
+def _u8_flags(xs) -> np.ndarray:
+    return np.asarray([1 if x else 0 for x in xs], dtype=np.uint8)
+
+
+def _term_args(cols, valids, is_f64, lo_i, hi_i, lo_f, hi_f, flags):
+    """The 11 leading ctypes arguments every range-term kernel takes
+    (hs_range_mask / hs_fused_filter_select / hs_fused_filter_agg's
+    filter section). Returns (args, keepalive): ``keepalive`` pins the
+    temporary numpy arrays for the duration of the call."""
+    k = len(cols)
+    _u8p = ctypes.POINTER(ctypes.c_uint8)
+    _i64p = ctypes.POINTER(ctypes.c_int64)
+    _f64p = ctypes.POINTER(ctypes.c_double)
+    col_ptrs = (ctypes.c_void_p * k)(*(c.ctypes.data for c in cols))
+    valid_arrs = [
+        None if v is None else np.ascontiguousarray(v, dtype=np.uint8)
+        for v in valids
+    ]
+    valid_ptrs = (ctypes.c_void_p * k)(
+        *(None if v is None else v.ctypes.data for v in valid_arrs)
+    )
+    is_f64_a = _u8_flags(is_f64)
+    has_lo = _u8_flags(f[0] for f in flags)
+    has_hi = _u8_flags(f[1] for f in flags)
+    lo_strict = _u8_flags(f[2] for f in flags)
+    hi_strict = _u8_flags(f[3] for f in flags)
+    lo_i_a = np.asarray(lo_i, dtype=np.int64)
+    hi_i_a = np.asarray(hi_i, dtype=np.int64)
+    lo_f_a = np.asarray(lo_f, dtype=np.float64)
+    hi_f_a = np.asarray(hi_f, dtype=np.float64)
+    keep = (
+        cols, valid_arrs, is_f64_a, has_lo, has_hi, lo_strict, hi_strict,
+        lo_i_a, hi_i_a, lo_f_a, hi_f_a,
+    )
+    args = [
+        col_ptrs,
+        valid_ptrs,
+        is_f64_a.ctypes.data_as(_u8p),
+        lo_i_a.ctypes.data_as(_i64p),
+        hi_i_a.ctypes.data_as(_i64p),
+        lo_f_a.ctypes.data_as(_f64p),
+        hi_f_a.ctypes.data_as(_f64p),
+        has_lo.ctypes.data_as(_u8p),
+        has_hi.ctypes.data_as(_u8p),
+        lo_strict.ctypes.data_as(_u8p),
+        hi_strict.ctypes.data_as(_u8p),
+    ]
+    return args, keep
+
+
 def range_mask_u8(
     cols,
     valids,
@@ -691,40 +779,11 @@ def range_mask_u8(
     k = len(cols)
     if k == 0 or n == 0:
         return np.ones(n, dtype=bool)
-    col_ptrs = (ctypes.c_void_p * k)(*(c.ctypes.data for c in cols))
-    valid_arrs = [
-        None if v is None else np.ascontiguousarray(v, dtype=np.uint8)
-        for v in valids
-    ]
-    valid_ptrs = (ctypes.c_void_p * k)(
-        *(None if v is None else v.ctypes.data for v in valid_arrs)
-    )
-    u8 = lambda xs: np.asarray([1 if x else 0 for x in xs], dtype=np.uint8)
-    is_f64_a = u8(is_f64)
-    has_lo = u8(f[0] for f in flags)
-    has_hi = u8(f[1] for f in flags)
-    lo_strict = u8(f[2] for f in flags)
-    hi_strict = u8(f[3] for f in flags)
-    lo_i_a = np.asarray(lo_i, dtype=np.int64)
-    hi_i_a = np.asarray(hi_i, dtype=np.int64)
-    lo_f_a = np.asarray(lo_f, dtype=np.float64)
-    hi_f_a = np.asarray(hi_f, dtype=np.float64)
+    args, _keep = _term_args(cols, valids, is_f64, lo_i, hi_i, lo_f, hi_f, flags)
     out = np.empty(n, dtype=np.uint8)
     _u8p = ctypes.POINTER(ctypes.c_uint8)
-    _i64p = ctypes.POINTER(ctypes.c_int64)
-    _f64p = ctypes.POINTER(ctypes.c_double)
     rc = lib.hs_range_mask(
-        col_ptrs,
-        valid_ptrs,
-        is_f64_a.ctypes.data_as(_u8p),
-        lo_i_a.ctypes.data_as(_i64p),
-        hi_i_a.ctypes.data_as(_i64p),
-        lo_f_a.ctypes.data_as(_f64p),
-        hi_f_a.ctypes.data_as(_f64p),
-        has_lo.ctypes.data_as(_u8p),
-        has_hi.ctypes.data_as(_u8p),
-        lo_strict.ctypes.data_as(_u8p),
-        hi_strict.ctypes.data_as(_u8p),
+        *args,
         ctypes.c_int32(k),
         ctypes.c_int64(n),
         out.ctypes.data_as(_u8p),
@@ -733,6 +792,158 @@ def range_mask_u8(
     if rc != 0:
         return None
     return out.view(np.bool_)
+
+
+def fused_filter_select(
+    cols,
+    valids,
+    is_f64,
+    lo_i,
+    hi_i,
+    lo_f,
+    hi_f,
+    flags,
+    n: int,
+) -> Optional[np.ndarray]:
+    """Passing row indices (ascending int64) of the fused range-term
+    conjunction — one pass computing AND compacting, replacing the
+    interpreted chain's materialized mask + ``np.nonzero`` (the
+    registered twin: ``pipeline_compiler.filter_select_interpreted``).
+    Same term layout as :func:`range_mask_u8`. Returns None when the
+    native kernel is unavailable (caller runs the interpreted chain)."""
+    lib = load(wait=False)
+    if lib is None:
+        return None
+    k = len(cols)
+    if k == 0 or n == 0:
+        return np.arange(n, dtype=np.int64)
+    args, _keep = _term_args(cols, valids, is_f64, lo_i, hi_i, lo_f, hi_f, flags)
+    out = np.empty(n, dtype=np.int64)
+    _i64p = ctypes.POINTER(ctypes.c_int64)
+    got = lib.hs_fused_filter_select(
+        *args,
+        ctypes.c_int32(k),
+        ctypes.c_int64(n),
+        out.ctypes.data_as(_i64p),
+        ctypes.c_int32(_n_threads(n)),
+    )
+    if got < 0:
+        return None
+    # copy: the n-capacity scratch must not stay pinned behind a small view
+    return out[:got].copy()
+
+
+def fused_filter_agg(
+    f_cols,
+    f_valids,
+    f_is_f64,
+    f_lo_i,
+    f_hi_i,
+    f_lo_f,
+    f_hi_f,
+    f_flags,
+    k_cols,
+    k_valids,
+    k_is_f64,
+    a_cols,
+    a_valids,
+    a_ops,
+    n: int,
+    row_start: int,
+    ht: np.ndarray,
+    g_hash: np.ndarray,
+    g_reps: np.ndarray,
+    g_nulls: np.ndarray,
+    g_kvals: np.ndarray,
+    g_kvalid: np.ndarray,
+    acc_i: np.ndarray,
+    acc_f: np.ndarray,
+    acc_cnt: np.ndarray,
+    acc_aux: np.ndarray,
+    n_groups: int,
+    rows_passed: int,
+    rebuild: bool,
+) -> Optional[Tuple[int, int, int]]:
+    """One chunk through the fused filter→group→aggregate pass
+    (``hs_fused_filter_agg``; state contract documented on the kernel).
+    Returns ``(rows_consumed, n_groups, rows_passed)`` — consumed <
+    ``n - row_start`` means the group table filled and the caller must
+    grow the state and re-call at the new offset — or None when the
+    native kernel is unavailable or rejects the arguments (caller runs
+    the interpreted twin, ``pipeline_compiler.interpreted_filter_aggregate``)."""
+    lib = load(wait=False)
+    if lib is None:
+        return None
+    targs, _keep = _term_args(
+        f_cols, f_valids, f_is_f64, f_lo_i, f_hi_i, f_lo_f, f_hi_f, f_flags
+    )
+    n_keys = len(k_cols)
+    n_aggs = len(a_ops)
+    key_ptrs = (ctypes.c_void_p * max(n_keys, 1))(
+        *(c.ctypes.data for c in k_cols) if n_keys else (None,)
+    )
+    kvalid_arrs = [
+        None if v is None else np.ascontiguousarray(v, dtype=np.uint8)
+        for v in k_valids
+    ]
+    kvalid_ptrs = (ctypes.c_void_p * max(n_keys, 1))(
+        *(None if v is None else v.ctypes.data for v in kvalid_arrs)
+        if n_keys
+        else (None,)
+    )
+    k_is_f64_a = _u8_flags(k_is_f64)
+    agg_ptrs = (ctypes.c_void_p * max(n_aggs, 1))(
+        *(None if c is None else c.ctypes.data for c in a_cols)
+        if n_aggs
+        else (None,)
+    )
+    avalid_arrs = [
+        None if v is None else np.ascontiguousarray(v, dtype=np.uint8)
+        for v in a_valids
+    ]
+    avalid_ptrs = (ctypes.c_void_p * max(n_aggs, 1))(
+        *(None if v is None else v.ctypes.data for v in avalid_arrs)
+        if n_aggs
+        else (None,)
+    )
+    a_ops_a = np.asarray(a_ops, dtype=np.uint8)
+    _u8p = ctypes.POINTER(ctypes.c_uint8)
+    _i64p = ctypes.POINTER(ctypes.c_int64)
+    _f64p = ctypes.POINTER(ctypes.c_double)
+    ng = ctypes.c_int64(n_groups)
+    rp = ctypes.c_int64(rows_passed)
+    consumed = lib.hs_fused_filter_agg(
+        *targs,
+        ctypes.c_int32(len(f_cols)),
+        key_ptrs,
+        kvalid_ptrs,
+        k_is_f64_a.ctypes.data_as(_u8p),
+        ctypes.c_int32(n_keys),
+        agg_ptrs,
+        avalid_ptrs,
+        a_ops_a.ctypes.data_as(_u8p),
+        ctypes.c_int32(n_aggs),
+        ctypes.c_int64(n),
+        ctypes.c_int64(row_start),
+        ht.ctypes.data_as(_i64p),
+        ctypes.c_int64(len(ht)),
+        g_hash.ctypes.data_as(_i64p),
+        g_reps.ctypes.data_as(_i64p),
+        g_nulls.ctypes.data_as(_u8p),
+        g_kvals.ctypes.data_as(_i64p),
+        g_kvalid.ctypes.data_as(_u8p),
+        acc_i.ctypes.data_as(_i64p),
+        acc_f.ctypes.data_as(_f64p),
+        acc_cnt.ctypes.data_as(_i64p),
+        acc_aux.ctypes.data_as(_i64p),
+        ctypes.c_int64(g_reps.shape[1] if g_reps.ndim == 2 else len(g_hash)),
+        ctypes.byref(ng),
+        ctypes.byref(rp),
+        ctypes.c_int32(1 if rebuild else 0),
+    )
+    if consumed < 0:
+        return None
+    return int(consumed), int(ng.value), int(rp.value)
 
 
 def bucket_ids_i64(

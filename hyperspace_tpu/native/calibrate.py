@@ -47,7 +47,7 @@ import numpy as np
 _log = logging.getLogger("hyperspace_tpu.native.calibrate")
 
 # Bump when the probe methodology changes; stale cache files re-probe.
-_PROBE_VERSION = 4
+_PROBE_VERSION = 5
 
 # Effectively-infinite row count: "this engine never loses on this
 # machine" (e.g. host vs device on a CPU backend, or a tunnel-attached
@@ -77,6 +77,7 @@ class Thresholds:
     native_expand_min_rows: int = 0
     native_gather_min_rows: int = 0
     native_range_mask_min_rows: int = 0
+    native_fused_pipeline_min_rows: int = 0
     source: str = "defaults"
 
 
@@ -306,6 +307,71 @@ def _probe_native_range_mask_min() -> int:
     return _NATIVE_PROBE_SIZES[-1] * 2
 
 
+def _probe_native_fused_pipeline_min() -> int:
+    """Crossover for the fused serve-pipeline pass
+    (``hs_fused_filter_agg``) vs the interpreted chain (mask → filtered
+    batch → factorize → segment reductions), probed at the SCANNED row
+    count with a serve-shaped workload: a two-term predicate (~50%
+    selective), one ~200-ary int64 group key, and count/sum/min
+    aggregates."""
+    from hyperspace_tpu.execution import pipeline_compiler as pc
+    from hyperspace_tpu.io.columnar import Column, ColumnarBatch
+    from hyperspace_tpu.plan.nodes import AggSpec
+
+    if _native_lib_or_busy() is None:
+        return 0
+    import pyarrow as pa
+
+    rng = np.random.default_rng(49)
+    schema = {"k": pa.int64(), "a": pa.int64(), "b": pa.float64()}
+    terms = (
+        ("a", 1000, False, 110000, True, False),
+        ("b", -1.0, True, None, False, False),
+    )
+    group_by = ["k"]
+    aggs = [
+        AggSpec("count", None, "n"),
+        AggSpec("sum", "b", "s"),
+        AggSpec("min", "a", "m"),
+    ]
+    for n in _NATIVE_PROBE_SIZES:
+        batch = ColumnarBatch(
+            {
+                "k": Column(
+                    "numeric",
+                    pa.int64(),
+                    values=rng.integers(0, 200, n, dtype=np.int64),
+                ),
+                "a": Column(
+                    "numeric",
+                    pa.int64(),
+                    values=rng.integers(0, 1 << 18, n, dtype=np.int64),
+                ),
+                "b": Column(
+                    "numeric", pa.float64(), values=rng.normal(0.0, 1.0, n)
+                ),
+            }
+        )
+        if (
+            pc.kernel_filter_aggregate(batch, terms, group_by, aggs, schema)
+            is None
+        ):
+            return 0  # kernel unavailable: fallback constant decides
+        t_native = _time_best(
+            lambda: pc.kernel_filter_aggregate(
+                batch, terms, group_by, aggs, schema
+            )
+        )
+        t_interp = _time_best(
+            lambda: pc.interpreted_filter_aggregate(
+                batch, terms, group_by, aggs, schema
+            )
+        )
+        if t_native < t_interp:
+            return n
+    return _NATIVE_PROBE_SIZES[-1] * 2
+
+
 def _probe_host_max(op: str, platform: str) -> int:
     """Smallest size where the device beats the host for ``op`` ("sort" |
     "hash"), extrapolated monotonic; _NEVER when the host wins at every
@@ -380,6 +446,7 @@ def _probe() -> Thresholds:
         native_expand_min_rows=_probe_native_expand_min(),
         native_gather_min_rows=_probe_native_gather_min(),
         native_range_mask_min_rows=_probe_native_range_mask_min(),
+        native_fused_pipeline_min_rows=_probe_native_fused_pipeline_min(),
         source="calibrated",
     )
     _log.info(
@@ -410,6 +477,9 @@ def _load_cache() -> Optional[Thresholds]:
             native_gather_min_rows=int(t["native_gather_min_rows"]),
             native_range_mask_min_rows=int(
                 t["native_range_mask_min_rows"]
+            ),
+            native_fused_pipeline_min_rows=int(
+                t["native_fused_pipeline_min_rows"]
             ),
             source="calibrated",
         )
@@ -448,6 +518,7 @@ def _store_cache(t: Thresholds) -> None:
                             "native_expand_min_rows",
                             "native_gather_min_rows",
                             "native_range_mask_min_rows",
+                            "native_fused_pipeline_min_rows",
                         )
                     },
                 },

@@ -114,6 +114,61 @@ void pass_threaded(Buffers& buf, int64_t n, int shift, int n_threads) {
   run_on_threads(T, scatter);
 }
 
+// Shared range-term predicate: one conjunct of the serve-path residual
+// mask (ops/filter.py lower_range_terms + native_range_bounds) — an
+// int64 or float64 column, optional lo/hi bounds with strictness, an
+// optional validity byte mask. Used by hs_range_mask,
+// hs_fused_filter_select and hs_fused_filter_agg so the three kernels
+// evaluate EXACTLY the same predicate semantics (IEEE float compares:
+// NaN fails every bound, same as the numpy twin).
+struct RangeTerms {
+  const void** cols;
+  const uint8_t** valids;  // may be nullptr / entries may be nullptr
+  const uint8_t* is_f64;
+  const int64_t* lo_i;
+  const int64_t* hi_i;
+  const double* lo_f;
+  const double* hi_f;
+  const uint8_t* has_lo;
+  const uint8_t* has_hi;
+  const uint8_t* lo_strict;
+  const uint8_t* hi_strict;
+  int32_t k;
+};
+
+inline bool terms_pass(const RangeTerms& t, int64_t r) {
+  for (int32_t i = 0; i < t.k; ++i) {
+    if (t.valids != nullptr && t.valids[i] != nullptr && !t.valids[i][r])
+      return false;
+    if (t.is_f64[i]) {
+      const double v = static_cast<const double*>(t.cols[i])[r];
+      if (t.has_lo[i] && !(t.lo_strict[i] ? v > t.lo_f[i] : v >= t.lo_f[i]))
+        return false;
+      if (t.has_hi[i] && !(t.hi_strict[i] ? v < t.hi_f[i] : v <= t.hi_f[i]))
+        return false;
+    } else {
+      const int64_t v = static_cast<const int64_t*>(t.cols[i])[r];
+      if (t.has_lo[i] && !(t.lo_strict[i] ? v > t.lo_i[i] : v >= t.lo_i[i]))
+        return false;
+      if (t.has_hi[i] && !(t.hi_strict[i] ? v < t.hi_i[i] : v <= t.hi_i[i]))
+        return false;
+    }
+  }
+  return true;
+}
+
+// splitmix64 finalizer: the fused-aggregate group hash. Quality matters
+// only for probe-length distribution; identity never depends on it (full
+// rep/null equality is compared on every probe hit).
+inline uint64_t mix64(uint64_t x) {
+  x ^= x >> 30;
+  x *= 0xBF58476D1CE4E5B9ull;
+  x ^= x >> 27;
+  x *= 0x94D049BB133111EBull;
+  x ^= x >> 31;
+  return x;
+}
+
 }  // namespace
 
 extern "C" {
@@ -458,41 +513,316 @@ int hs_range_mask(const void** cols, const uint8_t** valids,
   if (n_threads < 1) n_threads = 1;
   const int T = static_cast<int>(
       std::min<int64_t>(n < (1 << 16) ? 1 : n_threads, n));
+  const RangeTerms terms{cols,   valids, is_f64,    lo_i,      hi_i,
+                         lo_f,   hi_f,   has_lo,    has_hi,    lo_strict,
+                         hi_strict, k};
   try {
     const int64_t chunk = (n + T - 1) / T;
     auto work = [&](int th) {
       int64_t lo = th * chunk, hi = std::min<int64_t>(n, lo + chunk);
-      for (int64_t r = lo; r < hi; ++r) {
-        uint8_t ok = 1;
-        for (int32_t t = 0; t < k && ok; ++t) {
-          if (valids != nullptr && valids[t] != nullptr && !valids[t][r]) {
-            ok = 0;
-            break;
-          }
-          if (is_f64[t]) {
-            const double v = static_cast<const double*>(cols[t])[r];
-            if (has_lo[t] && !(lo_strict[t] ? v > lo_f[t] : v >= lo_f[t]))
-              ok = 0;
-            else if (has_hi[t] &&
-                     !(hi_strict[t] ? v < hi_f[t] : v <= hi_f[t]))
-              ok = 0;
-          } else {
-            const int64_t v = static_cast<const int64_t*>(cols[t])[r];
-            if (has_lo[t] && !(lo_strict[t] ? v > lo_i[t] : v >= lo_i[t]))
-              ok = 0;
-            else if (has_hi[t] &&
-                     !(hi_strict[t] ? v < hi_i[t] : v <= hi_i[t]))
-              ok = 0;
-          }
-        }
-        out[r] = ok;
-      }
+      for (int64_t r = lo; r < hi; ++r) out[r] = terms_pass(terms, r) ? 1 : 0;
     };
     run_on_threads(T, work);
   } catch (...) {
     return 2;
   }
   return 0;
+}
+
+// Fused filter-select: the passing ROW INDICES of the range-term
+// conjunction, ascending, written into out_idx (capacity n). The first
+// half of the Filter→Project lowering (docs/serve-compiler.md): one
+// pass computing pass/fail AND compacting indices replaces the
+// interpreted chain's materialized bool mask + np.nonzero; the caller
+// gathers the projected columns through the indices (the existing
+// threaded hs_gather kernels). Threaded two-phase (per-chunk count,
+// then disjoint fills), so the output order is deterministic and equal
+// to np.nonzero(mask). Returns the index count, -1 on bad arguments,
+// -2 on resource exhaustion.
+int64_t hs_fused_filter_select(const void** cols, const uint8_t** valids,
+                               const uint8_t* is_f64, const int64_t* lo_i,
+                               const int64_t* hi_i, const double* lo_f,
+                               const double* hi_f, const uint8_t* has_lo,
+                               const uint8_t* has_hi,
+                               const uint8_t* lo_strict,
+                               const uint8_t* hi_strict, int32_t k,
+                               int64_t n, int64_t* out_idx,
+                               int32_t n_threads) {
+  if (n < 0 || k <= 0 || (n > 0 && (cols == nullptr || out_idx == nullptr)))
+    return -1;
+  for (int32_t t = 0; t < k; ++t)
+    if (cols[t] == nullptr) return -1;
+  if (n == 0) return 0;
+  if (n_threads < 1) n_threads = 1;
+  const int T = static_cast<int>(
+      std::min<int64_t>(n < (1 << 16) ? 1 : n_threads, n));
+  const RangeTerms terms{cols,   valids, is_f64,    lo_i,      hi_i,
+                         lo_f,   hi_f,   has_lo,    has_hi,    lo_strict,
+                         hi_strict, k};
+  try {
+    const int64_t chunk = (n + T - 1) / T;
+    std::vector<int64_t> counts(T, 0);
+    auto count = [&](int t) {
+      int64_t lo = t * chunk, hi = std::min<int64_t>(n, lo + chunk);
+      int64_t c = 0;
+      for (int64_t r = lo; r < hi; ++r) c += terms_pass(terms, r) ? 1 : 0;
+      counts[t] = c;
+    };
+    run_on_threads(T, count);
+    std::vector<int64_t> offsets(T);
+    int64_t total = 0;
+    for (int t = 0; t < T; ++t) {
+      offsets[t] = total;
+      total += counts[t];
+    }
+    auto fill = [&](int t) {
+      int64_t lo = t * chunk, hi = std::min<int64_t>(n, lo + chunk);
+      int64_t out = offsets[t];
+      for (int64_t r = lo; r < hi; ++r)
+        if (terms_pass(terms, r)) out_idx[out++] = r;
+    };
+    run_on_threads(T, fill);
+    return total;
+  } catch (...) {
+    return -2;
+  }
+}
+
+// Fused filter-aggregate: the serve-pipeline compiler's inner pass
+// (docs/serve-compiler.md). For every row passing the range-term
+// conjunction, compute the group slot from the key columns' canonical
+// int64 reps (NULL/NaN/-0.0 canonicalization identical to
+// io/columnar.Column.key_rep) and fold the row into per-group partial
+// aggregates — COUNT(*)/COUNT(col)/SUM/MIN/MAX over int64-view and
+// float64 columns — without materializing the mask, the filtered batch,
+// or any per-row intermediate. The Python driver streams row-group
+// chunks through this kernel in file order with the SAME state arrays,
+// so accumulation order equals the interpreted chain's row order
+// (np.add.at / np.minimum.at are sequential; float sums are therefore
+// bit-identical, and deliberately single-threaded here).
+//
+// State contract (all owned/allocated by the caller):
+//   ht[ht_size]      open-addressing table (power of two, -1 = empty),
+//                    always strictly larger than g_cap so a probe always
+//                    finds an empty slot;
+//   g_hash/g_reps/g_nulls[n_keys*g_cap]/g_kvals/g_kvalid  per-group key
+//                    identity (hash, canonical rep, null flag) plus the
+//                    FIRST-OCCURRENCE raw key value + validity (what the
+//                    interpreted chain's batch.take(first) gathers);
+//   acc_i/acc_f/acc_cnt/acc_aux[n_aggs*g_cap]  accumulators, caller-
+//                    initialized per op (sum: 0, min: +sentinel, max:
+//                    -sentinel; cnt/aux: 0);
+//   rebuild != 0     re-insert the existing groups into a FRESH (all -1)
+//                    ht from g_hash before processing — how the caller
+//                    grows capacity without re-hashing in Python.
+//
+// Agg ops: 0 COUNT(*)  1 COUNT(col)  2 SUM i64  3 SUM f64
+//          4 MIN i64   5 MAX i64     6 MIN f64  7 MAX f64
+// Accumulation replicates the numpy twins exactly: int sums wrap mod
+// 2^64 (accumulated as uint64), float sums add 0.0 for passing-but-null
+// rows (np.add.at over zero-filled values), min/max use numpy's
+// replace-on-equal rule (acc = acc<v ? acc : v), float min/max track
+// has-clean / has-NaN flags for the Spark NaN ordering applied at
+// finalize time.
+//
+// Returns the number of rows CONSUMED starting at row_start (< n - row_start
+// when the group table fills mid-chunk: the caller grows the state and
+// re-calls at the returned offset; no row is ever half-applied), or -1 on
+// bad arguments.
+int64_t hs_fused_filter_agg(
+    const void** f_cols, const uint8_t** f_valids, const uint8_t* f_is_f64,
+    const int64_t* f_lo_i, const int64_t* f_hi_i, const double* f_lo_f,
+    const double* f_hi_f, const uint8_t* f_has_lo, const uint8_t* f_has_hi,
+    const uint8_t* f_lo_strict, const uint8_t* f_hi_strict, int32_t n_terms,
+    const void** k_cols, const uint8_t** k_valids, const uint8_t* k_is_f64,
+    int32_t n_keys, const void** a_cols, const uint8_t** a_valids,
+    const uint8_t* a_ops, int32_t n_aggs, int64_t n, int64_t row_start,
+    int64_t* ht, int64_t ht_size, int64_t* g_hash, int64_t* g_reps,
+    uint8_t* g_nulls, int64_t* g_kvals, uint8_t* g_kvalid, int64_t* acc_i,
+    double* acc_f, int64_t* acc_cnt, int64_t* acc_aux, int64_t g_cap,
+    int64_t* n_groups_io, int64_t* rows_passed_io, int32_t rebuild) {
+  if (n < 0 || row_start < 0 || row_start > n || n_terms < 0 ||
+      n_keys < 0 || n_keys > 16 || n_aggs < 0 || g_cap <= 0 ||
+      n_groups_io == nullptr || rows_passed_io == nullptr)
+    return -1;
+  if (n_terms > 0 && f_cols == nullptr) return -1;
+  if (n_keys > 0 &&
+      (k_cols == nullptr || ht == nullptr || ht_size <= g_cap ||
+       (ht_size & (ht_size - 1)) != 0 || g_hash == nullptr ||
+       g_reps == nullptr || g_nulls == nullptr || g_kvals == nullptr ||
+       g_kvalid == nullptr))
+    return -1;
+  if (n_aggs > 0 &&
+      (a_cols == nullptr || a_ops == nullptr || acc_i == nullptr ||
+       acc_f == nullptr || acc_cnt == nullptr || acc_aux == nullptr))
+    return -1;
+  int64_t n_groups = *n_groups_io;
+  if (n_groups < 0 || n_groups > g_cap) return -1;
+  if (n_keys == 0 && n_groups != 1) return -1;  // driver pre-seeds slot 0
+  for (int32_t a = 0; a < n_aggs; ++a) {
+    if (a_ops[a] > 7) return -1;
+    // ops 2..7 read the column; COUNT(*) / COUNT(col) only count
+    if (a_ops[a] >= 2 && a_cols[a] == nullptr) return -1;
+  }
+  const RangeTerms terms{f_cols,   f_valids, f_is_f64,    f_lo_i,
+                         f_hi_i,   f_lo_f,   f_hi_f,      f_has_lo,
+                         f_has_hi, f_lo_strict, f_hi_strict, n_terms};
+  const int64_t NULL_REP = -0x7FFFFFFFFFFFFF13LL;  // columnar.NULL_KEY_REP
+  const uint64_t hmask = n_keys > 0 ? static_cast<uint64_t>(ht_size) - 1 : 0;
+  if (rebuild && n_keys > 0) {
+    for (int64_t g = 0; g < n_groups; ++g) {
+      uint64_t s = static_cast<uint64_t>(g_hash[g]) & hmask;
+      while (ht[s] >= 0) s = (s + 1) & hmask;
+      ht[s] = g;
+    }
+  }
+  int64_t rep[16];
+  uint8_t nul[16];
+  int64_t passed = 0;
+  for (int64_t r = row_start; r < n; ++r) {
+    if (!terms_pass(terms, r)) continue;
+    int64_t g = 0;
+    if (n_keys > 0) {
+      uint64_t h = 0x9E3779B97F4A7C15ull;
+      for (int32_t j = 0; j < n_keys; ++j) {
+        const bool valid =
+            k_valids == nullptr || k_valids[j] == nullptr || k_valids[j][r];
+        if (!valid) {
+          rep[j] = NULL_REP;
+          nul[j] = 1;
+        } else {
+          nul[j] = 0;
+          if (k_is_f64[j]) {
+            const double v = static_cast<const double*>(k_cols[j])[r];
+            if (v != v) {
+              rep[j] = 0x7FF8000000000000LL;  // canonical NaN (key_rep)
+            } else if (v == 0.0) {
+              rep[j] = 0;  // -0.0 and 0.0 group together (key_rep)
+            } else {
+              std::memcpy(&rep[j], &v, 8);
+            }
+          } else {
+            rep[j] = static_cast<const int64_t*>(k_cols[j])[r];
+          }
+        }
+        h = mix64(h ^ static_cast<uint64_t>(rep[j]));
+        h = mix64(h ^ nul[j]);
+      }
+      uint64_t s = h & hmask;
+      while (true) {
+        const int64_t cand = ht[s];
+        if (cand < 0) {
+          if (n_groups >= g_cap) {
+            // table full: stop BEFORE touching row r; the caller grows
+            // the state and re-calls at this offset
+            *n_groups_io = n_groups;
+            *rows_passed_io += passed;
+            return r - row_start;
+          }
+          g = n_groups++;
+          ht[s] = g;
+          g_hash[g] = static_cast<int64_t>(h);
+          for (int32_t j = 0; j < n_keys; ++j) {
+            g_reps[static_cast<size_t>(j) * g_cap + g] = rep[j];
+            g_nulls[static_cast<size_t>(j) * g_cap + g] = nul[j];
+            int64_t raw;
+            std::memcpy(&raw,
+                        static_cast<const char*>(k_cols[j]) +
+                            static_cast<size_t>(r) * 8,
+                        8);
+            g_kvals[static_cast<size_t>(j) * g_cap + g] = raw;
+            g_kvalid[static_cast<size_t>(j) * g_cap + g] = nul[j] ? 0 : 1;
+          }
+          break;
+        }
+        if (g_hash[cand] == static_cast<int64_t>(h)) {
+          bool eq = true;
+          for (int32_t j = 0; j < n_keys; ++j) {
+            if (g_reps[static_cast<size_t>(j) * g_cap + cand] != rep[j] ||
+                g_nulls[static_cast<size_t>(j) * g_cap + cand] != nul[j]) {
+              eq = false;
+              break;
+            }
+          }
+          if (eq) {
+            g = cand;
+            break;
+          }
+        }
+        s = (s + 1) & hmask;
+      }
+    }
+    ++passed;
+    for (int32_t a = 0; a < n_aggs; ++a) {
+      const size_t slot = static_cast<size_t>(a) * g_cap + g;
+      const bool av =
+          a_valids == nullptr || a_valids[a] == nullptr || a_valids[a][r];
+      switch (a_ops[a]) {
+        case 0:  // COUNT(*)
+          ++acc_cnt[slot];
+          break;
+        case 1:  // COUNT(col)
+          acc_cnt[slot] += av ? 1 : 0;
+          break;
+        case 2: {  // SUM i64 (wraps mod 2^64, same as numpy int64 adds)
+          const int64_t v =
+              av ? static_cast<const int64_t*>(a_cols[a])[r] : 0;
+          acc_i[slot] = static_cast<int64_t>(
+              static_cast<uint64_t>(acc_i[slot]) + static_cast<uint64_t>(v));
+          acc_cnt[slot] += av ? 1 : 0;
+          break;
+        }
+        case 3: {  // SUM f64 (+0.0 for null rows, like np.add.at)
+          const double v =
+              av ? static_cast<const double*>(a_cols[a])[r] : 0.0;
+          acc_f[slot] += v;
+          acc_cnt[slot] += av ? 1 : 0;
+          break;
+        }
+        case 4:  // MIN i64
+          if (av) {
+            const int64_t v = static_cast<const int64_t*>(a_cols[a])[r];
+            ++acc_cnt[slot];
+            acc_i[slot] = acc_i[slot] < v ? acc_i[slot] : v;
+          }
+          break;
+        case 5:  // MAX i64
+          if (av) {
+            const int64_t v = static_cast<const int64_t*>(a_cols[a])[r];
+            ++acc_cnt[slot];
+            acc_i[slot] = acc_i[slot] > v ? acc_i[slot] : v;
+          }
+          break;
+        case 6:  // MIN f64 (np.minimum replace-on-equal; NaN excluded,
+                 // aux counts clean rows for the Spark NaN rule)
+          if (av) {
+            const double v = static_cast<const double*>(a_cols[a])[r];
+            ++acc_cnt[slot];
+            if (!(v != v)) {
+              ++acc_aux[slot];
+              acc_f[slot] = acc_f[slot] < v ? acc_f[slot] : v;
+            }
+          }
+          break;
+        case 7:  // MAX f64 (any valid NaN wins at finalize; aux counts NaNs)
+          if (av) {
+            const double v = static_cast<const double*>(a_cols[a])[r];
+            ++acc_cnt[slot];
+            if (v != v) {
+              ++acc_aux[slot];
+            } else {
+              acc_f[slot] = acc_f[slot] > v ? acc_f[slot] : v;
+            }
+          }
+          break;
+        default:  // unreachable: ops validated before the row loop
+          break;
+      }
+    }
+  }
+  *n_groups_io = n_groups;
+  *rows_passed_io += passed;
+  return n - row_start;
 }
 
 // MurmurHash3-32 bucket ids over k int64 key columns, one pass per row.

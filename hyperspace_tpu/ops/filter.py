@@ -295,12 +295,43 @@ def _native_range_mask_min_rows() -> int:
     )
 
 
+class _BatchColTypes:
+    """Lazy ``name -> (dtype_kind, arrow_type)`` view of a batch for
+    :func:`lower_range_terms_typed` — only the columns the condition
+    actually references are inspected (a wide covering index would
+    otherwise pay an all-columns dict per filter serve)."""
+
+    def __init__(self, batch):
+        self._batch = batch
+
+    def __contains__(self, name) -> bool:
+        return name in self._batch.columns
+
+    def __getitem__(self, name):
+        col = self._batch.columns[name]
+        return (
+            "S" if col.kind == "string" else col.values.dtype.kind,
+            col.arrow_type,
+        )
+
+
 def lower_range_terms(expr: E.Expr, batch):
     """[(name, lo, lo_strict, hi, hi_strict, empty)] when EVERY conjunct
     is a numeric col-vs-lit comparison in =,<,<=,>,>= with a literal the
     engine can compare (temporal literals lowered with the same op-aware
     snapping the interpreter uses), else None. ``empty`` marks a conjunct
     whose lowered literal can never match (all-False mask)."""
+    return lower_range_terms_typed(expr, _BatchColTypes(batch))
+
+
+def lower_range_terms_typed(expr: E.Expr, cols):
+    """:func:`lower_range_terms` against a ``{name: (dtype_kind,
+    arrow_type)}`` mapping instead of a materialized batch — the
+    pre-read half the serve-pipeline compiler needs (the decoded numpy
+    dtype kind is derivable from the arrow type before any file is
+    opened; see ``pipeline_compiler._np_kind``). The batch-based wrapper
+    above feeds it the actual decoded kinds, so the two can never
+    disagree on a column the batch carries."""
     terms = []
     for cj in E.split_conjuncts(expr):
         norm = E.normalize_comparison(cj)
@@ -309,15 +340,14 @@ def lower_range_terms(expr: E.Expr, batch):
         op, name, lit = norm
         if op == "!=":
             return None
-        if name not in batch.columns:
+        if name not in cols:
             return None
-        col = batch.columns[name]
-        if col.kind != "numeric":
+        kind, arrow_type = cols[name]
+        if kind == "S":
             return None
-        kind = col.values.dtype.kind
         if kind not in "if":
             return None  # uint/bool columns keep the interpreter path
-        lv = E.lower_literal(lit, col.arrow_type, op)
+        lv = E.lower_literal(lit, arrow_type, op)
         if lv is None:
             terms.append((name, None, False, None, False, True))
             continue
@@ -369,32 +399,28 @@ def range_mask_numpy(batch, terms) -> np.ndarray:
     return out
 
 
-def _native_range_mask(batch, terms) -> Optional[np.ndarray]:
-    """Native dispatch of the fused mask: contiguous 8-byte numeric
-    columns with exactly-representable bounds only — anything else
-    returns None and the numpy twin runs. Integer bounds given as floats
-    tighten to the enclosing integers (exact on integer domains)."""
-    cols = []
-    valids = []
-    is_f64 = []
-    lo_i = []
-    hi_i = []
-    lo_f = []
-    hi_f = []
-    flags = []  # (has_lo, has_hi, lo_strict, hi_strict)
-    n = batch.num_rows
-    for name, lo, lo_strict, hi, hi_strict, empty in terms:
-        col = batch.columns[name]
+NEVER_MATCH = "never"
+
+
+def native_range_bounds(terms, f64_flags):
+    """Lower range-term bounds into the exact int64/float64 form the
+    native kernels compare with — shared by ``hs_range_mask``,
+    ``hs_fused_filter_select`` and ``hs_fused_filter_agg`` so the three
+    can never disagree with the numpy twin on a bound.
+
+    ``f64_flags``: per-term bool, True when the column is float64 (else
+    an int64-view column). Returns ``(lo_i, hi_i, lo_f, hi_f, flags)``
+    lists aligned with ``terms``, :data:`NEVER_MATCH` when some bound can
+    never hold (all-False mask), or None when a bound is not exactly
+    representable natively (the numpy twin must decide). Integer bounds
+    given as floats tighten to the enclosing integers (exact on integer
+    domains)."""
+    lo_i, hi_i, lo_f, hi_f, flags = [], [], [], [], []
+    for (name, lo, lo_strict, hi, hi_strict, empty), f64 in zip(
+        terms, f64_flags
+    ):
         if empty:
-            return np.zeros(n, dtype=bool)
-        v = col.values
-        if v.ndim != 1 or v.dtype.itemsize != 8 or not v.flags.c_contiguous:
-            return None
-        f64 = v.dtype.kind == "f"
-        if f64 and v.dtype != np.float64:
-            return None
-        if not f64 and v.dtype.kind not in "iMm":
-            return None
+            return NEVER_MATCH
 
         def int_bound(b, is_lo):
             """(bound, strict) in exact int64, or None to bail."""
@@ -459,7 +485,7 @@ def _native_range_mask(batch, terms) -> Optional[np.ndarray]:
             if ilo is None or ihi is None:
                 return None
             if ilo == "never" or ihi == "never":
-                return np.zeros(n, dtype=bool)
+                return NEVER_MATCH
             has_lo = ilo != "unbounded"
             has_hi = ihi != "unbounded"
             lo_i.append(ilo[0] if has_lo else 0)
@@ -474,14 +500,51 @@ def _native_range_mask(batch, terms) -> Optional[np.ndarray]:
                     ihi[1] if has_hi else False,
                 )
             )
+    return lo_i, hi_i, lo_f, hi_f, flags
+
+
+def native_terms_for_batch(batch, terms):
+    """The full native argument set for ``terms`` over ``batch``:
+    ``(cols, valids, is_f64, lo_i, hi_i, lo_f, hi_f, flags)`` ready for
+    ``native.range_mask_u8`` / ``native.fused_filter_select``, or
+    :data:`NEVER_MATCH` (all-False), or None (numpy twin decides —
+    non-8-byte/non-contiguous columns or unrepresentable bounds)."""
+    cols = []
+    valids = []
+    is_f64 = []
+    for name, _lo, _ls, _hi, _hs, _empty in terms:
+        col = batch.columns[name]
+        v = col.values
+        if v.ndim != 1 or v.dtype.itemsize != 8 or not v.flags.c_contiguous:
+            return None
+        f64 = v.dtype.kind == "f"
+        if f64 and v.dtype != np.float64:
+            return None
+        if not f64 and v.dtype.kind not in "iMm":
+            return None
         is_f64.append(f64)
         cols.append(v if f64 else v.view(np.int64))
         valids.append(col.validity)
+    bounds = native_range_bounds(terms, is_f64)
+    if bounds is None or bounds == NEVER_MATCH:
+        return bounds
+    lo_i, hi_i, lo_f, hi_f, flags = bounds
+    return cols, valids, is_f64, lo_i, hi_i, lo_f, hi_f, flags
+
+
+def _native_range_mask(batch, terms) -> Optional[np.ndarray]:
+    """Native dispatch of the fused mask: contiguous 8-byte numeric
+    columns with exactly-representable bounds only — anything else
+    returns None and the numpy twin runs."""
+    n = batch.num_rows
+    prep = native_terms_for_batch(batch, terms)
+    if prep is None:
+        return None
+    if prep == NEVER_MATCH:
+        return np.zeros(n, dtype=bool)
     from hyperspace_tpu import native
 
-    return native.range_mask_u8(
-        cols, valids, is_f64, lo_i, hi_i, lo_f, hi_f, flags, n
-    )
+    return native.range_mask_u8(*prep, n)
 
 
 def range_mask(batch, terms) -> np.ndarray:

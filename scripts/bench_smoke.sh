@@ -36,6 +36,17 @@ zp = d["zorder_prune"]
 assert zp["row_groups_total"] > 0, "rangeprune telemetry missing"
 assert "zonemap_hit_rate" in zp, zp
 assert "zorder_range_pruneoff_p50_ms" in d, "prune A/B leg missing"
+# the fused serve-pipeline compiler must actually have run on both
+# aggregate rows (the A/B legs are meaningless if the on leg silently
+# fell back to the interpreted chain)
+for row in ("filter_agg", "grouped_agg"):
+    fa = d[row]
+    assert fa["fused_ran"], f"{row}: fused pipeline did not run: {fa}"
+    assert fa["stats"]["rows_scanned"] > 0, fa
+    assert fa["stats"]["chunks"] >= 1, fa
+assert d["grouped_agg"]["stats"]["groups"] > 1, d["grouped_agg"]
+print("bench_smoke: fused pipeline ok:", d["filter_agg"]["stats"],
+      d["grouped_agg"]["stats"], file=sys.stderr)
 mesh = d["mesh_ladder"]
 assert mesh, "mesh ladder rows missing"
 multi = [r for r in mesh if r["devices"] > 1]

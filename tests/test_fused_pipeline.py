@@ -1,0 +1,463 @@
+"""Fused serve-pipeline compiler: three-way differential property suite.
+
+The contract under test (docs/serve-compiler.md,
+execution/pipeline_compiler.py): for every supported
+``Filter(→Project)→Aggregate`` subtree over a pruned index scan,
+``fused ≡ interpreted`` BIT-IDENTICALLY (same rows, same order, same
+float bit patterns, same validity presence), and the fused result agrees
+with the unindexed scan up to float-sum reassociation (different row
+order feeding the sum). The suite runs the three-way
+(fusedpipeline on ≡ off ≡ unindexed) across the dtype matrix from
+``tests/test_range_prune.py`` — over range-pruned (z-order) and
+bucket-pruned (covering) scans — including NaN/null groups, empty
+row-group survivors, dispatch-threshold fallbacks, and the flag-off
+restore of the old path.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu import functions as F
+from hyperspace_tpu.execution import pipeline_compiler as PC
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.indexes import zonemaps
+from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+from hyperspace_tpu.indexes.zorder import ZOrderCoveringIndexConfig
+
+
+@pytest.fixture
+def s1(session_factory):
+    """Mesh-1 session: the fused pass is a host compute substitution
+    with no mesh axis."""
+    return session_factory(1)
+
+
+@pytest.fixture(autouse=True)
+def _force_fused_dispatch():
+    """Dispatch the fused kernel at test sizes (the calibrated crossover
+    would otherwise route tiny fixtures to the interpreted chain and the
+    suite would silently test nothing)."""
+    old = PC._NATIVE_FUSED_PIPELINE_MIN_ROWS
+    PC._NATIVE_FUSED_PIPELINE_MIN_ROWS = 1
+    try:
+        yield
+    finally:
+        PC._NATIVE_FUSED_PIPELINE_MIN_ROWS = old
+
+
+def _write_files(tmp_path, name, table, n_files=4):
+    d = tmp_path / name
+    d.mkdir()
+    n = table.num_rows
+    for i in range(n_files):
+        lo, hi = i * n // n_files, (i + 1) * n // n_files
+        pq.write_table(table.slice(lo, hi - lo), str(d / f"part{i}.parquet"))
+    return str(d)
+
+
+def _tables_bit_equal(a: pa.Table, b: pa.Table) -> None:
+    """Exact equality including row order and float BIT patterns —
+    arrow's ``.equals`` treats NaN != NaN, which would reject identical
+    aggregate outputs over NaN-bearing groups."""
+    assert a.schema.equals(b.schema), (a.schema, b.schema)
+    assert a.num_rows == b.num_rows, (a.num_rows, b.num_rows)
+    for name in a.column_names:
+        ca = a.column(name).combine_chunks()
+        cb = b.column(name).combine_chunks()
+        assert ca.is_valid().equals(cb.is_valid()), name
+        if pa.types.is_floating(ca.type):
+            va = np.asarray(ca.fill_null(0.0)).view(np.int64)
+            vb = np.asarray(cb.fill_null(0.0)).view(np.int64)
+            np.testing.assert_array_equal(va, vb, err_msg=name)
+        else:
+            assert ca.equals(cb), name
+
+
+def _three_way(session, q, expect_fused=True):
+    """q() with fusedpipeline on vs off (both index-served) vs the
+    unindexed scan. on ≡ off bit-identically; vs raw the group keys and
+    counts must agree exactly (float sums may reassociate across the
+    different row order). Returns the fused-on table."""
+    session.enable_hyperspace()
+    zonemaps.invalidate_local_cache()
+    PC.last_fused_stats = {}
+    on = q()
+    ran = PC.last_fused_stats.get("mode") == "agg"
+    if expect_fused:
+        assert ran, f"fused pipeline did not run: {PC.last_fused_stats}"
+    session.conf.set(C.SERVE_FUSEDPIPELINE_ENABLED, False)
+    PC.last_fused_stats = {}
+    off = q()
+    assert PC.last_fused_stats == {}, "fused ran with the flag off"
+    session.conf.unset(C.SERVE_FUSEDPIPELINE_ENABLED)
+    session.disable_hyperspace()
+    raw = q()
+    _tables_bit_equal(on, off)
+    assert on.num_rows == raw.num_rows, (on.num_rows, raw.num_rows)
+    return on
+
+
+def _dtype_tables(rng, n=8000):
+    """(name, arrays, cond_fn, agg_fn) — the range-prune dtype matrix
+    extended with per-dtype aggregates (sum/min/max only where the fused
+    set supports the type; strings keep count-only)."""
+    base = np.datetime64("2019-01-01")
+    days = np.sort(rng.integers(0, 900, n))
+
+    def num_aggs(f):
+        return (
+            F.count().alias("n"),
+            F.count("c").alias("nc"),
+            F.min("c").alias("mn"),
+            F.max("c").alias("mx"),
+            F.sum("v").alias("sv"),
+            F.avg("v").alias("av"),
+        )
+
+    def temporal_aggs(f):
+        return (
+            F.count().alias("n"),
+            F.min("c").alias("mn"),
+            F.max("c").alias("mx"),
+            F.sum("v").alias("sv"),
+        )
+
+    def count_only(f):
+        return (F.count().alias("n"), F.count("c").alias("nc"))
+
+    v = rng.normal(0, 5, n)
+    common = {
+        "p": pa.array(rng.integers(0, 10, n), type=pa.int64()),
+        "v": pa.array(v),
+    }
+    yield "ints", {
+        "c": pa.array(np.sort(rng.integers(-1000, 1000, n)), type=pa.int64()),
+        **common,
+    }, lambda df: (df["c"] >= -100) & (df["c"] < 250), num_aggs
+    f = rng.normal(0, 100, n)
+    f[::31] = np.nan
+    yield "floats_nan", {
+        "c": pa.array(f),
+        **common,
+    }, lambda df: (df["c"] > -50.0) & (df["c"] <= 50.0), num_aggs
+    yield "strings", {
+        "c": pa.array([f"k{int(x):06d}" for x in rng.integers(0, 5000, n)]),
+        **common,
+    }, lambda df: (df["p"] >= 2) & (df["p"] < 7), count_only
+    yield "dates", {
+        "c": pa.array((base + days).astype("datetime64[D]")),
+        **common,
+    }, lambda df: (
+        (df["c"] >= np.datetime64("2019-06-01"))
+        & (df["c"] <= np.datetime64("2019-09-01"))
+    ), temporal_aggs
+    yield "ts_tz", {
+        "c": pa.array(
+            (base + days).astype("datetime64[us]"),
+            type=pa.timestamp("us", tz="UTC"),
+        ),
+        **common,
+    }, lambda df: (df["c"] >= "2019-06-01") & (df["c"] < "2019-09-01"), (
+        temporal_aggs
+    )
+    yield "nullable_int", {
+        "c": pa.array(
+            [
+                None if i % 11 == 0 else int(x)
+                for i, x in enumerate(np.sort(rng.integers(0, 10_000, n)))
+            ],
+            type=pa.int64(),
+        ),
+        **common,
+    }, lambda df: (df["c"] > 2000) & (df["c"] <= 4000), num_aggs
+
+
+class TestRangePrunedAggregateMatrix:
+    """Aggregate over a RANGE-PRUNED (z-order) scan: pruned ≡ unpruned ≡
+    fused across the dtype matrix. The z index narrows files/row groups
+    before the fused pass consumes the survivors."""
+
+    def test_dtype_matrix_grouped(self, s1, tmp_path):
+        hs = Hyperspace(s1)
+        rng = np.random.default_rng(7)
+        for name, arrays, cond_fn, agg_fn in _dtype_tables(rng):
+            d = _write_files(tmp_path, name, pa.table(arrays))
+            df = s1.read.parquet(d)
+            # the strings row filters on p (string terms are outside the
+            # fused set), so ITS index keys on p — the query must be
+            # index-served for the fused pass to engage at all
+            icols = ["p"] if name == "strings" else ["c"]
+            inc = [c for c in ("c", "p", "v") if c not in icols]
+            hs.create_index(
+                df, ZOrderCoveringIndexConfig(f"z_{name}", icols, inc)
+            )
+            # string filter columns are outside the fused term set: the
+            # "strings" row filters on p instead so the fused pass runs,
+            # and the count-only aggs keep string c in play via COUNT(c)
+            q = lambda: (
+                df.filter(cond_fn(df))
+                .group_by("p")
+                .agg(*agg_fn(df))
+                .collect()
+            )
+            out = _three_way(s1, q)
+            assert 0 < out.num_rows <= 10, (name, out.num_rows)
+            hs.delete_index(f"z_{name}")
+            hs.vacuum_index(f"z_{name}")
+            s1.index_manager.clear_cache()
+
+    def test_nan_and_null_group_keys(self, s1, tmp_path):
+        """Group keys with NaN payloads and NULLs: NaNs one group, nulls
+        one group, both orderable — and the fused key column carries the
+        FIRST-occurrence raw value exactly like take(first)."""
+        hs = Hyperspace(s1)
+        rng = np.random.default_rng(11)
+        n = 6000
+        g = rng.normal(0, 2, n).round(1)
+        g[::13] = np.nan
+        g[::17] = -0.0
+        g[::19] = 0.0
+        arrays = {
+            "c": pa.array(np.sort(rng.integers(0, 5000, n)), type=pa.int64()),
+            "g": pa.array(
+                [None if i % 23 == 0 else float(x) for i, x in enumerate(g)],
+                type=pa.float64(),
+            ),
+            "v": pa.array(rng.normal(0, 5, n)),
+        }
+        d = _write_files(tmp_path, "nanng", pa.table(arrays))
+        df = s1.read.parquet(d)
+        hs.create_index(
+            df, ZOrderCoveringIndexConfig("z_nn", ["c"], ["g", "v"])
+        )
+        q = lambda: (
+            df.filter((df["c"] >= 500) & (df["c"] < 3500))
+            .group_by("g")
+            .agg(
+                F.count().alias("n"),
+                F.sum("v").alias("sv"),
+                F.min("v").alias("mnv"),
+                F.max("v").alias("mxv"),
+            )
+            .collect()
+        )
+        out = _three_way(s1, q)
+        keys = out.column("g")
+        assert keys.null_count == 1  # the null group
+        assert any(
+            v.as_py() is not None and np.isnan(v.as_py())
+            for v in keys.combine_chunks()
+            if v.is_valid
+        )
+
+    def test_empty_row_group_survivors(self, s1, tmp_path):
+        """A range that prunes some files to EMPTY row-group tuples: the
+        fused pass must stream zero-row chunks without disturbing the
+        carried state, and an all-pruned predicate must yield the same
+        empty/zero result as the interpreted chain."""
+        hs = Hyperspace(s1)
+        rng = np.random.default_rng(13)
+        n = 8000
+        arrays = {
+            "c": pa.array(
+                np.sort(rng.integers(0, 100_000, n)), type=pa.int64()
+            ),
+            "p": pa.array(rng.integers(0, 10, n), type=pa.int64()),
+            "v": pa.array(rng.normal(0, 5, n)),
+        }
+        d = _write_files(tmp_path, "empties", pa.table(arrays))
+        df = s1.read.parquet(d)
+        s1.conf.set(C.ZORDER_TARGET_SOURCE_BYTES_PER_PARTITION, 16 * 1024)
+        hs.create_index(
+            df, ZOrderCoveringIndexConfig("z_e", ["c"], ["p", "v"])
+        )
+        s1.conf.unset(C.ZORDER_TARGET_SOURCE_BYTES_PER_PARTITION)
+        # narrow range: most z files pruned, some survive
+        q = lambda: (
+            df.filter((df["c"] >= 10_000) & (df["c"] < 12_000))
+            .group_by("p")
+            .agg(F.count().alias("n"), F.sum("v").alias("sv"))
+            .collect()
+        )
+        out = _three_way(s1, q)
+        assert out.num_rows > 0
+        # empty-range predicate: grouped result has zero rows, ungrouped
+        # yields the one global row with count 0 — identical both paths
+        qe = lambda: (
+            df.filter((df["c"] >= 100_001) & (df["c"] < 100_002))
+            .group_by("p")
+            .agg(F.count().alias("n"))
+            .collect()
+        )
+        oute = _three_way(s1, qe, expect_fused=False)
+        assert oute.num_rows == 0
+        qg = lambda: (
+            df.filter((df["c"] >= 100_001) & (df["c"] < 100_002))
+            .agg(F.count().alias("n"), F.sum("v").alias("sv"))
+            .collect()
+        )
+        outg = _three_way(s1, qg, expect_fused=False)
+        assert outg.column("n").to_pylist() == [0]
+        assert outg.column("sv").to_pylist() == [None]
+
+
+class TestBucketPrunedAggregate:
+    def test_bucket_pruned_grouped(self, s1, tmp_path):
+        """Aggregate over a BUCKET-PRUNED covering-index scan: the
+        point predicate drops bucket files, the fused pass consumes the
+        surviving buckets."""
+        hs = Hyperspace(s1)
+        rng = np.random.default_rng(17)
+        n = 6000
+        arrays = {
+            "k": pa.array(rng.integers(0, 50, n), type=pa.int64()),
+            "p": pa.array(rng.integers(0, 5, n), type=pa.int64()),
+            "v": pa.array(rng.normal(0, 5, n)),
+        }
+        d = _write_files(tmp_path, "bp", pa.table(arrays))
+        df = s1.read.parquet(d)
+        hs.create_index(df, CoveringIndexConfig("ci_bp", ["k"], ["p", "v"]))
+        s1.conf.set(C.INDEX_FILTER_RULE_USE_BUCKET_SPEC, True)
+        try:
+            q = lambda: (
+                df.filter(df["k"] == 7)
+                .group_by("p")
+                .agg(
+                    F.count().alias("n"),
+                    F.sum("v").alias("sv"),
+                    F.min("v").alias("mn"),
+                    F.max("v").alias("mx"),
+                )
+                .collect()
+            )
+            out = _three_way(s1, q)
+            assert out.num_rows > 0
+        finally:
+            s1.conf.unset(C.INDEX_FILTER_RULE_USE_BUCKET_SPEC)
+
+
+class TestDispatchAndFallback:
+    def _mk(self, s1, tmp_path, name="disp"):
+        hs = Hyperspace(s1)
+        rng = np.random.default_rng(19)
+        n = 5000
+        arrays = {
+            "c": pa.array(np.sort(rng.integers(0, 5000, n)), type=pa.int64()),
+            "p": pa.array(rng.integers(0, 8, n), type=pa.int64()),
+            "v": pa.array(rng.normal(0, 5, n)),
+        }
+        d = _write_files(tmp_path, name, pa.table(arrays))
+        df = s1.read.parquet(d)
+        hs.create_index(
+            df, ZOrderCoveringIndexConfig(f"z_{name}", ["c"], ["p", "v"])
+        )
+        q = lambda: (
+            df.filter((df["c"] >= 1000) & (df["c"] < 3000))
+            .group_by("p")
+            .agg(F.count().alias("n"), F.sum("v").alias("sv"))
+            .collect()
+        )
+        return q
+
+    def test_below_threshold_falls_back(self, s1, tmp_path):
+        """Below the calibrated crossover the interpreted chain runs —
+        same result, no fused telemetry."""
+        q = self._mk(s1, tmp_path)
+        s1.enable_hyperspace()
+        PC._NATIVE_FUSED_PIPELINE_MIN_ROWS = 1 << 30
+        PC.last_fused_stats = {}
+        small = q()
+        assert PC.last_fused_stats.get("mode") != "agg"
+        PC._NATIVE_FUSED_PIPELINE_MIN_ROWS = 1
+        PC.last_fused_stats = {}
+        fused = q()
+        assert PC.last_fused_stats.get("mode") == "agg"
+        s1.disable_hyperspace()
+        _tables_bit_equal(small, fused)
+
+    def test_unsupported_predicate_falls_back(self, s1, tmp_path):
+        """OR / IN / string predicates are outside the fused term set:
+        the interpreted chain serves them, results unchanged."""
+        hs = Hyperspace(s1)
+        rng = np.random.default_rng(23)
+        n = 5000
+        arrays = {
+            "c": pa.array(np.sort(rng.integers(0, 5000, n)), type=pa.int64()),
+            "p": pa.array(rng.integers(0, 8, n), type=pa.int64()),
+            "v": pa.array(rng.normal(0, 5, n)),
+        }
+        d = _write_files(tmp_path, "unsup", pa.table(arrays))
+        df = s1.read.parquet(d)
+        hs.create_index(
+            df, ZOrderCoveringIndexConfig("z_unsup", ["c"], ["p", "v"])
+        )
+        q = lambda: (
+            df.filter((df["c"] < 100) | (df["c"] > 4000))
+            .group_by("p")
+            .agg(F.count().alias("n"))
+            .collect()
+        )
+        out = _three_way(s1, q, expect_fused=False)
+        assert out.num_rows > 0
+
+    def test_serve_cache_fused_over_ram(self, s1, tmp_path):
+        """Serve-server mode: the fused pass runs over the RAM-resident
+        cached scan (chunks == 1, no parquet), the compiled lowering is
+        a ("fusedplan", …) entry, and evict_kind reclaims it."""
+        q = self._mk(s1, tmp_path, name="cache")
+        s1.enable_hyperspace()
+        s1.conf.set(C.SERVE_CACHE_ENABLED, True)
+        try:
+            cold = q()
+            PC.last_fused_stats = {}
+            warm = q()
+            st = dict(PC.last_fused_stats)
+            assert st.get("mode") == "agg" and st.get("chunks") == 1, st
+            _tables_bit_equal(cold, warm)
+            kinds = {k[0] for k in s1.serve_cache._entries}
+            assert "fusedplan" in kinds, kinds
+            assert s1.serve_cache.evict_kind("fusedplan") >= 1
+        finally:
+            s1.conf.set(C.SERVE_CACHE_ENABLED, False)
+            s1.clear_serve_cache()
+            s1.disable_hyperspace()
+
+
+class TestFusedFilterProject:
+    def test_filter_project_three_way(self, s1, tmp_path):
+        """Plain Filter→Project over the index: the fused select kernel
+        replaces mask + nonzero, output rows bit-identical including
+        string columns carried through the projection."""
+        hs = Hyperspace(s1)
+        rng = np.random.default_rng(29)
+        n = 6000
+        arrays = {
+            "c": pa.array(np.sort(rng.integers(0, 5000, n)), type=pa.int64()),
+            "s": pa.array([f"v{int(x) % 97:03d}" for x in rng.integers(0, 10**6, n)]),
+            "v": pa.array(rng.normal(0, 5, n)),
+        }
+        d = _write_files(tmp_path, "fp", pa.table(arrays))
+        df = s1.read.parquet(d)
+        hs.create_index(
+            df, ZOrderCoveringIndexConfig("z_fp", ["c"], ["s", "v"])
+        )
+        q = lambda: (
+            df.filter((df["c"] >= 1000) & (df["c"] < 3000))
+            .select("c", "s", "v")
+            .collect()
+        )
+        s1.enable_hyperspace()
+        zonemaps.invalidate_local_cache()
+        PC.last_fused_stats = {}
+        on = q()
+        assert PC.last_fused_stats.get("mode") == "select", PC.last_fused_stats
+        s1.conf.set(C.SERVE_FUSEDPIPELINE_ENABLED, False)
+        off = q()
+        s1.conf.unset(C.SERVE_FUSEDPIPELINE_ENABLED)
+        s1.disable_hyperspace()
+        raw = q()
+        _tables_bit_equal(on, off)
+        assert on.num_rows == raw.num_rows

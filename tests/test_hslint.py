@@ -115,6 +115,14 @@ NATIVE_OK = '''
         return None
 '''
 
+CPP_FUSED = '''
+    extern "C" {
+    int64_t hs_fused_bar(const int* a, long long n) {
+      return 0;
+    }
+    }  // extern "C"
+'''
+
 
 class TestKernelParity:
     def test_missing_registry_entry(self, tmp_path):
@@ -158,6 +166,44 @@ class TestKernelParity:
             tmp_path,
             files,
             tests={"test_foo.py": "def test_foo():\n    assert foo\n"},
+        )
+        assert findings == []
+
+    def test_fused_export_with_numpy_twin_flagged(self, tmp_path):
+        # seeded violation: a fused-pipeline export registered against a
+        # numpy single-op twin — HS105 requires the in-package
+        # interpreted chain as the parity reference
+        files = {
+            "native/hs_native.cpp": CPP_FUSED,
+            "native/__init__.py": (
+                "KERNEL_TWINS = {\n"
+                '    "hs_fused_bar": ("fused_bar", "numpy.lexsort"),\n'
+                "}\n"
+                "def fused_bar():\n    return None\n"
+            ),
+        }
+        findings = _lint(
+            tmp_path,
+            files,
+            tests={"test_bar.py": "def test_bar():\n    assert fused_bar\n"},
+        )
+        assert "HS105" in _rules(findings)
+
+    def test_fused_export_with_interpreted_twin_clean(self, tmp_path):
+        files = {
+            "native/hs_native.cpp": CPP_FUSED,
+            "native/__init__.py": (
+                "KERNEL_TWINS = {\n"
+                '    "hs_fused_bar": ("fused_bar", "pkg.chain.interpreted_bar"),\n'
+                "}\n"
+                "def fused_bar():\n    return None\n"
+            ),
+            "chain.py": "def interpreted_bar():\n    return None\n",
+        }
+        findings = _lint(
+            tmp_path,
+            files,
+            tests={"test_bar.py": "def test_bar():\n    assert fused_bar\n"},
         )
         assert findings == []
 
@@ -622,6 +668,7 @@ class TestGolden:
         "HS102",
         "HS103",
         "HS104",
+        "HS105",
         "HS201",
         "HS202",
         "HS203",

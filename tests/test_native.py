@@ -1085,3 +1085,316 @@ class TestRangeMaskParity:
             F._NATIVE_RANGE_MASK_MIN_ROWS = old
         assert got is not None
         np.testing.assert_array_equal(got, ref)
+
+
+# ---------------------------------------------------------------------------
+# Fused serve-pipeline kernels (docs/serve-compiler.md): differential
+# parity of hs_fused_filter_select / hs_fused_filter_agg against the
+# registered INTERPRETED twins (pipeline_compiler.filter_select_interpreted
+# / interpreted_filter_aggregate) — KERNEL_TWINS generalized from single
+# kernels to whole pipelines, incl. float-sum accumulation order.
+# ---------------------------------------------------------------------------
+
+
+def _pc():
+    from hyperspace_tpu.execution import pipeline_compiler as pc
+
+    return pc
+
+
+def _fused_batch(n, seed=0, with_nulls=True, float_key=False):
+    import pyarrow as pa
+
+    from hyperspace_tpu.io.columnar import Column, ColumnarBatch
+
+    rng = np.random.default_rng(seed)
+    k = rng.integers(0, max(n // 40, 3), n, dtype=np.int64)
+    a = rng.integers(-100, 100, n, dtype=np.int64)
+    b = rng.normal(0, 10, n)
+    b[rng.random(n) < 0.03] = np.nan
+    b[rng.random(n) < 0.01] = -0.0
+    cols = {
+        "k": Column(
+            "numeric",
+            pa.int64(),
+            values=k,
+            validity=(rng.random(n) > 0.05) if with_nulls else None,
+        ),
+        "a": Column("numeric", pa.int64(), values=a),
+        "b": Column(
+            "numeric",
+            pa.float64(),
+            values=b,
+            validity=(rng.random(n) > 0.08) if with_nulls else None,
+        ),
+    }
+    if float_key:
+        fk = rng.normal(0, 1, n)
+        fk[::17] = np.nan
+        fk[::13] = -0.0
+        fk[::11] = 0.0
+        cols["fk"] = Column("numeric", pa.float64(), values=fk)
+    schema = {nm: c.arrow_type for nm, c in cols.items()}
+    return ColumnarBatch(cols), schema
+
+
+def _assert_batches_bit_equal(a, b):
+    """Bitwise batch equality: arrow's .equals treats NaN != NaN, so
+    float columns compare by their int64 bit patterns after aligning
+    validity — the right notion for the fused twin contract."""
+    import pyarrow as pa
+
+    ta, tb = a.to_arrow(), b.to_arrow()
+    assert ta.schema.equals(tb.schema), (ta.schema, tb.schema)
+    assert ta.num_rows == tb.num_rows, (ta.num_rows, tb.num_rows)
+    for name in ta.column_names:
+        ca = ta.column(name).combine_chunks()
+        cb = tb.column(name).combine_chunks()
+        assert ca.is_valid().equals(cb.is_valid()), name
+        if pa.types.is_floating(ca.type):
+            va = np.asarray(ca.fill_null(0.0)).view(np.int64)
+            vb = np.asarray(cb.fill_null(0.0)).view(np.int64)
+            np.testing.assert_array_equal(va, vb, err_msg=name)
+        else:
+            assert ca.equals(cb), name
+
+
+_TERMS = (("a", -50, False, 70, True, False),)
+
+
+def _all_agg_specs():
+    from hyperspace_tpu.plan.nodes import AggSpec
+
+    return [
+        AggSpec("count", None, "n"),
+        AggSpec("count", "b", "nb"),
+        AggSpec("sum", "a", "sa"),
+        AggSpec("sum", "b", "sb"),
+        AggSpec("min", "a", "mna"),
+        AggSpec("max", "a", "mxa"),
+        AggSpec("min", "b", "mnb"),
+        AggSpec("max", "b", "mxb"),
+        AggSpec("avg", "b", "ab"),
+    ]
+
+
+class TestFusedFilterSelectParity:
+    def _check(self, batch, terms):
+        from hyperspace_tpu.ops import filter as F
+
+        pc = _pc()
+        prep = F.native_terms_for_batch(batch, terms)
+        assert prep is not None and prep != F.NEVER_MATCH
+        got = native.fused_filter_select(*prep, batch.num_rows)
+        assert got is not None
+        np.testing.assert_array_equal(
+            got, pc.filter_select_interpreted(batch, terms)
+        )
+
+    @pytest.mark.parametrize("n", [1, 7, 1000, 100_000])
+    def test_random(self, n):
+        batch, _ = _fused_batch(n, seed=n)
+        self._check(batch, _TERMS)
+
+    def test_nulls_and_float_terms(self):
+        batch, _ = _fused_batch(50_000, seed=3)
+        self._check(
+            batch,
+            (
+                ("a", -50, False, None, False, False),
+                ("b", -5.0, True, 5.0, False, False),
+            ),
+        )
+
+    def test_none_pass_and_all_pass(self):
+        batch, _ = _fused_batch(10_000, seed=5, with_nulls=False)
+        self._check(batch, (("a", 1000, False, None, False, False),))
+        self._check(batch, (("a", None, False, 1000, False, False),))
+
+
+class TestFusedFilterAggParity:
+    def _check(self, batches, terms, group_by, aggs, schema):
+        pc = _pc()
+        if isinstance(batches, list):
+            from hyperspace_tpu.io.columnar import ColumnarBatch
+
+            whole = ColumnarBatch.concat(batches)
+        else:
+            whole = batches
+        ref = pc.interpreted_filter_aggregate(
+            whole, terms, group_by, aggs, schema
+        )
+        got = pc.kernel_filter_aggregate(batches, terms, group_by, aggs, schema)
+        assert got is not None, "fused kernel path bailed"
+        _assert_batches_bit_equal(ref, got)
+
+    @pytest.mark.parametrize("n", [1, 37, 5000, 120_000])
+    def test_grouped_all_ops(self, n):
+        batch, schema = _fused_batch(n, seed=n)
+        self._check(batch, _TERMS, ["k"], _all_agg_specs(), schema)
+
+    def test_ungrouped_all_ops(self):
+        batch, schema = _fused_batch(80_000, seed=11)
+        self._check(batch, _TERMS, [], _all_agg_specs(), schema)
+
+    def test_float_key_nan_negzero_groups(self):
+        # NaN payloads collapse to one group, -0.0/0.0 group together,
+        # and the FIRST-occurrence raw value is what the key column holds
+        batch, schema = _fused_batch(60_000, seed=13, float_key=True)
+        self._check(batch, _TERMS, ["fk"], _all_agg_specs(), schema)
+
+    def test_multi_key_with_null_groups(self):
+        batch, schema = _fused_batch(40_000, seed=17, float_key=True)
+        self._check(batch, _TERMS, ["k", "fk"], _all_agg_specs(), schema)
+
+    def test_chunked_equals_single_batch(self):
+        # the executor streams row-group chunks through ONE carried
+        # state: float sums are only bit-identical if accumulation
+        # order equals row order across chunk boundaries
+        batch, schema = _fused_batch(90_000, seed=19)
+        n = batch.num_rows
+        cuts = [0, n // 3, n // 3 + 1, 2 * n // 3, n]
+        from hyperspace_tpu.io.columnar import Column, ColumnarBatch
+
+        chunks = []
+        for lo, hi in zip(cuts, cuts[1:]):
+            chunks.append(
+                ColumnarBatch(
+                    {
+                        nm: Column(
+                            "numeric",
+                            c.arrow_type,
+                            values=c.values[lo:hi],
+                            validity=None
+                            if c.validity is None
+                            else c.validity[lo:hi],
+                        )
+                        for nm, c in batch.columns.items()
+                    }
+                )
+            )
+        self._check(chunks, _TERMS, ["k"], _all_agg_specs(), schema)
+
+    def test_group_growth_and_rebuild(self):
+        # >> the 1024 initial capacity: forces the kernel's stop-grow-
+        # rebuild handshake mid-chunk, repeatedly
+        import pyarrow as pa
+
+        from hyperspace_tpu.io.columnar import Column, ColumnarBatch
+        from hyperspace_tpu.plan.nodes import AggSpec
+
+        rng = np.random.default_rng(23)
+        n = 150_000
+        batch = ColumnarBatch(
+            {
+                "k": Column(
+                    "numeric",
+                    pa.int64(),
+                    values=rng.integers(0, 1 << 62, n, dtype=np.int64),
+                ),
+                "a": Column(
+                    "numeric",
+                    pa.int64(),
+                    values=rng.integers(-100, 100, n, dtype=np.int64),
+                ),
+            }
+        )
+        schema = {"k": pa.int64(), "a": pa.int64()}
+        aggs = [AggSpec("count", None, "n"), AggSpec("sum", "a", "sa")]
+        self._check(
+            batch, (("a", -90, False, None, False, False),), ["k"], aggs,
+            schema,
+        )
+
+    def test_empty_result_grouped_and_ungrouped(self):
+        batch, schema = _fused_batch(20_000, seed=29)
+        never = (("a", 1000, False, None, False, False),)
+        self._check(batch, never, ["k"], _all_agg_specs(), schema)
+        self._check(batch, never, [], _all_agg_specs(), schema)
+
+    def test_int64_sum_wraparound(self):
+        # numpy int64 sums wrap mod 2^64; the kernel accumulates as
+        # uint64 for the same bit pattern instead of UB signed overflow
+        import pyarrow as pa
+
+        from hyperspace_tpu.io.columnar import Column, ColumnarBatch
+        from hyperspace_tpu.plan.nodes import AggSpec
+
+        n = 4096
+        vals = np.full(n, (1 << 62) + 12345, dtype=np.int64)
+        batch = ColumnarBatch(
+            {
+                "k": Column(
+                    "numeric", pa.int64(), values=np.zeros(n, dtype=np.int64)
+                ),
+                "a": Column("numeric", pa.int64(), values=vals),
+            }
+        )
+        schema = {"k": pa.int64(), "a": pa.int64()}
+        self._check(
+            batch,
+            (("a", 0, False, None, False, False),),
+            ["k"],
+            [AggSpec("sum", "a", "sa")],
+            schema,
+        )
+
+    def test_count_col_over_string_column(self):
+        # COUNT(col) reads only the valid mask, so string columns are
+        # countable through the fused pass
+        import pyarrow as pa
+
+        from hyperspace_tpu.io.columnar import Column, ColumnarBatch
+        from hyperspace_tpu.plan.nodes import AggSpec
+
+        batch, schema = _fused_batch(30_000, seed=31)
+        scol = Column.from_arrow(
+            pa.array(
+                [
+                    None if i % 7 == 0 else f"s{i % 11}"
+                    for i in range(batch.num_rows)
+                ]
+            )
+        )
+        batch = batch.with_column("s", scol)
+        schema = dict(schema)
+        schema["s"] = pa.string()
+        self._check(
+            batch,
+            _TERMS,
+            ["k"],
+            [AggSpec("count", "s", "ns"), AggSpec("count", None, "n")],
+            schema,
+        )
+
+    def test_unsupported_shapes_bail_to_interpreter(self):
+        # string group key / string min-max / sub-8-byte columns must
+        # return None (the executor runs the interpreted chain)
+        import pyarrow as pa
+
+        from hyperspace_tpu.plan.nodes import AggSpec
+
+        pc = _pc()
+        batch, schema = _fused_batch(5000, seed=37)
+        schema2 = dict(schema)
+        schema2["s"] = pa.string()
+        assert (
+            pc.kernel_filter_aggregate(
+                batch, _TERMS, ["s"], [AggSpec("count", None, "n")], schema2
+            )
+            is None
+        )
+        assert (
+            pc.kernel_filter_aggregate(
+                batch, _TERMS, ["k"], [AggSpec("min", "s", "m")], schema2
+            )
+            is None
+        )
+        schema3 = dict(schema)
+        schema3["a"] = pa.int32()  # decodes to 4 bytes: not fusable
+        assert (
+            pc.kernel_filter_aggregate(
+                batch, _TERMS, ["k"], [AggSpec("sum", "a", "sa")], schema3
+            )
+            is None
+        )
