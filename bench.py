@@ -27,6 +27,7 @@ import os
 import shutil
 import sys
 import tempfile
+import threading
 import time
 
 import numpy as np
@@ -340,6 +341,147 @@ def main() -> None:
             f"({join_raw['p50'] / join_cached['p50']:.2f}x); "
             f"{cache.resident_bytes / 1e6:.0f}MB resident"
         )
+        # --- concurrent serve frontend (serve/frontend.py): the
+        # contention ladder — the SAME indexed point workload at 1/8/64
+        # clients through the admission-controlled frontend (snapshot
+        # pinning, single-flight, shedding). p50/p99 are client-observed;
+        # QPS counts completed queries over the rung's wall clock. Keys
+        # cycle a 256-key working set so the serve cache is exercised
+        # (warm hits) without single-flight collapsing the whole rung
+        # into one execution.
+        from hyperspace_tpu.serve import ServeFrontend
+        from hyperspace_tpu.testing import faults as _flt
+
+        rng_k = np.random.default_rng(23)
+        ladder_keys = [
+            int(k) for k in rng_k.integers(0, n_orders, 256)
+        ]
+
+        def q_point_k(k):
+            return items.filter(items["l_orderkey"] == k).select(
+                "l_orderkey", "l_quantity"
+            )
+
+        def serve_rung(clients, queries_per_client=8):
+            session.clear_serve_cache()
+            fe = ServeFrontend(session)
+            lats, errors = [], []
+            lat_lock = threading.Lock()
+
+            def client(ci):
+                try:
+                    for j in range(queries_per_client):
+                        k = ladder_keys[
+                            (ci * queries_per_client + j) % len(ladder_keys)
+                        ]
+                        t0 = time.perf_counter()
+                        fe.serve(q_point_k(k))
+                        dt = time.perf_counter() - t0
+                        with lat_lock:
+                            lats.append(dt)
+                except Exception as exc:
+                    errors.append(exc)
+
+            t0 = time.perf_counter()
+            threads = [
+                threading.Thread(target=client, args=(ci,))
+                for ci in range(clients)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            cache = session.serve_cache
+            stats = fe.stats()
+            fe.close()
+            assert not errors, errors[:3]
+            assert cache.high_water_bytes <= cache.max_bytes
+            lats.sort()
+            return {
+                "clients": clients,
+                "queries": len(lats),
+                "p50_ms": round(lats[len(lats) // 2] * 1e3, 2),
+                "p99_ms": round(
+                    lats[min(len(lats) - 1, len(lats) * 99 // 100)] * 1e3, 2
+                ),
+                "qps": round(len(lats) / wall, 1),
+                "cache_high_water_bytes": cache.high_water_bytes,
+                "cache_max_bytes": cache.max_bytes,
+                "deduped": stats["deduped"],
+                "shed": stats["shed"],
+                "retries": stats["retries"],
+            }
+
+        serve_concurrency = []
+        for clients in (1, 8, 64):
+            row = serve_rung(clients)
+            serve_concurrency.append(row)
+            log(
+                f"serve frontend {clients:>2} clients: p50 {row['p50_ms']}ms "
+                f"p99 {row['p99_ms']}ms {row['qps']} qps "
+                f"(deduped {row['deduped']}, cache high-water "
+                f"{row['cache_high_water_bytes'] / 1e6:.0f}MB)"
+            )
+
+        # --- fault-injection rung (testing/faults.py): one serve per
+        # injection point x {transient, persistent}, each differential
+        # against the fault-free result — the bench-level witness that
+        # every point fires and the retry/degrade paths answer
+        # bit-identically (bench_smoke.sh asserts the fired counts)
+        # two query shapes per leg: the point filter exercises the read/
+        # log/cache seams; the filter→aggregate exercises the fused
+        # native pass, whose dispatch (native.load) is where the
+        # kernel_dispatch point lives — a tiny point query can sit below
+        # every native threshold and never touch the loader. The
+        # aggregate sums an INT column only: the parquet_read-persistent
+        # leg degrades to the source-order plan, and float sums are not
+        # associative across the index-vs-source row orders (the same
+        # boundary docs/serve-compiler.md documents) — int sums are
+        # exact under any order, keeping the differential bitwise.
+        def q_fault_agg(df):
+            return df.filter(
+                (df["l_orderkey"] >= agg_lo) & (df["l_orderkey"] < agg_hi)
+            ).agg(
+                hsf.count().alias("n"),
+                hsf.sum("l_quantity").alias("sq"),
+            )
+
+        fault_qs = [q_point_k(ladder_keys[0]), q_fault_agg(items)]
+        fault_bases = [session.execute(q.logical_plan) for q in fault_qs]
+        _flt.reset()
+        fe = ServeFrontend(session)
+        for point, spec in (
+            ("parquet_read", "transient:1"),
+            ("parquet_read", "persistent;match=v__="),
+            ("kernel_dispatch", "transient:1"),
+            ("kernel_dispatch", "persistent"),
+            ("log_read", "transient:1"),
+            ("log_read", "persistent"),
+            ("cache_insert", "transient:1"),
+            ("cache_insert", "persistent"),
+        ):
+            session.clear_serve_cache()
+            session.index_manager.clear_cache()
+            _flt.set_fault(point, spec)
+            for q, base_t in zip(fault_qs, fault_bases):
+                out = fe.serve(q)
+                assert out.equals(base_t), (point, spec)
+            _flt.clear()
+        fault_stats = fe.stats()
+        fe.close()
+        fault_fired = _flt.stats()
+        _flt.reset()
+        missing = [p for p in _flt.POINTS if fault_fired.get(p, 0) < 1]
+        assert not missing, f"fault points never fired: {missing}"
+        log(
+            f"fault matrix: fired {fault_fired}; frontend retries "
+            f"{fault_stats['retries']}, degraded {fault_stats['degraded']}, "
+            f"degraded pins {fault_stats['degraded_pins']}, failed "
+            f"{fault_stats['failed']}"
+        )
+        assert fault_stats["failed"] == 0
+
         session.conf.set(C.SERVE_CACHE_ENABLED, False)
         session.clear_serve_cache()  # later stages measure uncached paths;
         # keeping 200+MB resident would only add allocator/page pressure
@@ -850,6 +992,16 @@ def main() -> None:
                     "join_cached_speedup": round(
                         join_raw["p50"] / join_cached["p50"], 3
                     ),
+                    "serve_concurrency": serve_concurrency,
+                    "fault_injection": {
+                        "fired": fault_fired,
+                        "frontend_retries": fault_stats["retries"],
+                        "frontend_degraded": fault_stats["degraded"],
+                        "frontend_degraded_pins": fault_stats[
+                            "degraded_pins"
+                        ],
+                        "frontend_failed": fault_stats["failed"],
+                    },
                     "join_rows_out": j_rows,
                     "join_serve_stage_ms": join_stages,
                     "hybrid_join_indexed_p50_ms": ms(hybrid_idx),

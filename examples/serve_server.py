@@ -79,6 +79,43 @@ def main():
         f"{cache.resident_bytes / 1e6:.1f}MB resident"
     )
 
+    # --- concurrent serving (docs/serve-server.md): 16 client threads
+    # through the admission-controlled frontend — snapshot pinning,
+    # single-flight dedup of identical plans, retry/degrade on faults
+    import threading
+
+    fe = session.serve_frontend
+
+    def client(cid, uids):
+        for uid in uids:
+            fe.serve(
+                df.filter(df["user_id"] == int(uid)).select("ts", "value")
+            )
+
+    rng2 = np.random.default_rng(2)
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(
+            target=client, args=(i, rng2.integers(0, 50_000, 8))
+        )
+        for i in range(16)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    st = fe.stats()
+    print(
+        f"16 concurrent clients x 8 lookups: {st['completed']} served "
+        f"({st['deduped']} deduped) in {wall * 1e3:.0f}ms "
+        f"(p50 {st.get('p50_s', 0) * 1e3:.2f}ms, "
+        f"p99 {st.get('p99_s', 0) * 1e3:.2f}ms); "
+        f"cache high-water {cache.high_water_bytes / 1e6:.1f}MB "
+        f"of {cache.max_bytes / 1e9:.0f}GB budget"
+    )
+    fe.close()
+
 
 if __name__ == "__main__":
     main()

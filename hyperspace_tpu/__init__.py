@@ -51,6 +51,11 @@ _LAZY = {
         "DataSkippingIndexConfig",
     ),
     "functions": ("hyperspace_tpu.functions", None),
+    "ServeFrontend": ("hyperspace_tpu.serve", "ServeFrontend"),
+    "ServeOverloadedError": (
+        "hyperspace_tpu.exceptions",
+        "ServeOverloadedError",
+    ),
 }
 
 

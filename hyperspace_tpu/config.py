@@ -56,6 +56,19 @@ class Config:
     def get_str(self, key: str, default: str = "") -> str:
         return str(self._values.get(key, default))
 
+    def prefixed(self, prefix: str) -> dict:
+        """All ``{key: value}`` pairs whose key starts with ``prefix`` —
+        the fault-injection registry (``testing/faults.py``) scans
+        ``hyperspace.faults.*`` through this without reaching into the
+        private value dict. Iterates a snapshot: a concurrent ``set()``
+        of a new key (serve workers share one conf) must not blow up
+        the iteration."""
+        return {
+            k: v
+            for k, v in list(self._values.items())
+            if k.startswith(prefix)
+        }
+
     # -- typed accessors (HyperspaceConf.scala) -----------------------------
     @property
     def apply_enabled(self) -> bool:
@@ -186,6 +199,42 @@ class Config:
     def serve_cache_max_bytes(self) -> int:
         return self.get_int(
             C.SERVE_CACHE_MAX_BYTES, C.SERVE_CACHE_MAX_BYTES_DEFAULT
+        )
+
+    @property
+    def serve_max_concurrency(self) -> int:
+        """Serve-frontend worker threads (0 = auto-size)."""
+        n = self.get_int(
+            C.SERVE_MAX_CONCURRENCY, C.SERVE_MAX_CONCURRENCY_DEFAULT
+        )
+        if n > 0:
+            return n
+        import os
+
+        return min(32, 4 * (os.cpu_count() or 1))
+
+    @property
+    def serve_max_queue_depth(self) -> int:
+        return self.get_int(
+            C.SERVE_MAX_QUEUE_DEPTH, C.SERVE_MAX_QUEUE_DEPTH_DEFAULT
+        )
+
+    @property
+    def serve_retry_max_attempts(self) -> int:
+        return max(
+            1,
+            self.get_int(
+                C.SERVE_RETRY_MAX_ATTEMPTS, C.SERVE_RETRY_MAX_ATTEMPTS_DEFAULT
+            ),
+        )
+
+    @property
+    def serve_retry_backoff_ms(self) -> int:
+        return max(
+            0,
+            self.get_int(
+                C.SERVE_RETRY_BACKOFF_MS, C.SERVE_RETRY_BACKOFF_MS_DEFAULT
+            ),
         )
 
     @property

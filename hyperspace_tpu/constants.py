@@ -262,3 +262,40 @@ SERVE_FUSEDPIPELINE_ENABLED_DEFAULT = True
 # (native/calibrate.py, native_fused_pipeline_min_rows); this constant
 # is the probe-failure fallback, like every other dispatch threshold.
 NATIVE_FUSED_PIPELINE_MIN_ROWS_DEFAULT = 1 << 15
+
+# -- concurrent serve frontend (hyperspace_tpu/serve/) -----------------------
+# Worker threads answering queries concurrently. 0 = auto: min(32,
+# 4 x cores) — serve work is read-dominated (parquet/Arrow release the
+# GIL), so oversubscribing cores keeps the scan pool fed while masks/
+# merges run.
+SERVE_MAX_CONCURRENCY = "hyperspace.serve.maxConcurrency"
+SERVE_MAX_CONCURRENCY_DEFAULT = 0
+
+# Admission control: queries queued (admitted but not yet running)
+# beyond this bound are shed with a typed ServeOverloadedError instead
+# of growing an unbounded backlog whose tail latency is unbounded too.
+# 0 = unbounded (benchmark/batch use).
+SERVE_MAX_QUEUE_DEPTH = "hyperspace.serve.maxQueueDepth"
+SERVE_MAX_QUEUE_DEPTH_DEFAULT = 128
+
+# Retry-with-backoff for TRANSIENT failures at the serve operation
+# boundary (Exoshuffle doctrine: fault handling lives in the
+# application-level dataflow, not under it): maxAttempts total tries,
+# exponential backoff starting at backoffMs. Each retry re-pins the
+# index snapshot, so a vacuum that removed the pinned version's files
+# mid-query recovers onto the current version.
+SERVE_RETRY_MAX_ATTEMPTS = "hyperspace.serve.retry.maxAttempts"
+SERVE_RETRY_MAX_ATTEMPTS_DEFAULT = 3
+SERVE_RETRY_BACKOFF_MS = "hyperspace.serve.retry.backoffMs"
+SERVE_RETRY_BACKOFF_MS_DEFAULT = 10
+
+# Fault injection (hyperspace_tpu/testing/faults.py): config keys
+# ``hyperspace.faults.<point>`` name an injection point with a spec like
+# "transient", "transient:3", "persistent", or "persistent;match=v__="
+# (match = only paths containing the substring fault). Points:
+# parquet_read, kernel_dispatch, log_read, cache_insert. The keys are
+# READ only by an explicit ``faults.configure(session.conf)`` call (an
+# operator/test act — production never arms itself); the serve plane's
+# retry/degrade behavior under armed faults is the tested contract
+# (docs/serve-server.md fault matrix).
+FAULTS_KEY_PREFIX = "hyperspace.faults."

@@ -25,3 +25,16 @@ class ConcurrentWriteException(HyperspaceException):
     (``index/IndexLogManager.scala:178-194``): another writer created the
     same log id first.
     """
+
+
+class ServeOverloadedError(HyperspaceException):
+    """Admission control shed this query: the serve frontend's queue of
+    admitted-but-not-running queries reached
+    ``hyperspace.serve.maxQueueDepth`` (``serve/frontend.py``).
+
+    Deliberately a TYPED error raised at submit time, before any work is
+    queued: a caller (load balancer, client retry budget) can
+    distinguish "the system is saturated, back off" from a query that
+    failed — queueing past the bound would only convert overload into
+    unbounded tail latency.
+    """

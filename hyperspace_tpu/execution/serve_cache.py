@@ -45,6 +45,7 @@ from collections import OrderedDict
 from typing import Optional, Tuple
 
 from hyperspace_tpu.io.columnar import ColumnarBatch
+from hyperspace_tpu.testing import faults
 
 
 def file_fingerprint(files) -> Optional[Tuple]:
@@ -74,8 +75,29 @@ def batch_nbytes(batch: ColumnarBatch) -> int:
 
 
 class ServeCache:
-    """Thread-safe LRU cache, byte-capped. Values carry their own size
-    (entries are (value, nbytes) internally)."""
+    """Thread-safe LRU cache, byte-capped — the serve plane's memory
+    governor. Values carry their own size (entries are (value, nbytes)
+    internally).
+
+    Lock discipline (audited for the concurrent serve frontend,
+    ``serve/frontend.py``; covered by the two-thread race tests in
+    ``tests/test_serve_cache.py``): ONE lock guards the entry map, the
+    byte ledger and every counter, and every public method takes it for
+    its whole critical section — so ``resident_bytes`` can never
+    observe a half-applied put, an eviction can never interleave with a
+    replace's pop/re-add, and ``evict_kind`` snapshots its victim list
+    under the same lock that guards concurrent ``get``/``put``. No I/O
+    and no user code runs under the lock (values are stored, never
+    inspected), keeping it HS502-clean and O(1)-held. Values handed out
+    by ``get`` may outlive their entry (a racing eviction drops the
+    cache's reference, not the caller's) — safe because every cached
+    value is immutable by the publication contracts documented above.
+
+    The governor's accounting invariant — ``resident_bytes`` equals the
+    exact sum of resident entry sizes and never exceeds ``max_bytes`` —
+    is what the byte budget means under concurrency; the stress tests
+    assert it while readers, writers and evictors race.
+    """
 
     def __init__(self, max_bytes: int):
         self.max_bytes = int(max_bytes)
@@ -84,6 +106,13 @@ class ServeCache:
         self._bytes = 0
         self.hits = 0
         self.misses = 0
+        # resident-set telemetry (memory governor): high-water mark of
+        # the byte ledger, cumulative LRU evictions, inserts dropped by
+        # an armed cache_insert fault (testing/faults.py)
+        self.high_water_bytes = 0
+        self.evictions = 0
+        self.evicted_bytes = 0
+        self.insert_failures = 0
 
     def get(self, key):
         with self._lock:
@@ -104,22 +133,45 @@ class ServeCache:
             return None if entry is None else entry[0]
 
     def put(self, key, value, nbytes: int) -> None:
+        # fault-injection seam: a failing insert must never fail the
+        # query — the value simply stays uncached (degrade-in-place),
+        # counted so operators can see a sick cache backend. The detail
+        # (the key's kind) is passed raw; it is stringified only when
+        # the point is armed, like the parquet_read seam.
+        if faults.degraded("cache_insert", key[:1] if key else ""):
+            with self._lock:
+                self.insert_failures += 1
+            return
         if nbytes > self.max_bytes:
             return  # larger than the whole cache: not cacheable
         with self._lock:
             old = self._entries.pop(key, None)
             if old is not None:
                 self._bytes -= old[1]
-            self._entries[key] = (value, nbytes)
-            self._bytes += nbytes
-            while self._bytes > self.max_bytes and self._entries:
+            # evict BEFORE inserting: the ledger never overshoots the
+            # budget even transiently, so an unsynchronized
+            # ``resident_bytes`` probe (telemetry threads, the stress
+            # tests' budget assertion) can never observe a value past
+            # ``max_bytes``
+            while self._bytes + nbytes > self.max_bytes and self._entries:
                 _, (_, freed) = self._entries.popitem(last=False)
                 self._bytes -= freed
+                self.evictions += 1
+                self.evicted_bytes += freed
+            self._entries[key] = (value, nbytes)
+            self._bytes += nbytes
+            if self._bytes > self.high_water_bytes:
+                self.high_water_bytes = self._bytes
 
     def clear(self) -> None:
+        """Empty the cache and start a fresh telemetry epoch: the
+        high-water mark resets with the contents (cumulative counters —
+        hits/misses/evictions — keep counting), so per-phase probes
+        (bench rungs) report their own peak, not an earlier phase's."""
         with self._lock:
             self._entries.clear()
             self._bytes = 0
+            self.high_water_bytes = 0
 
     def evict_kind(self, kind: str) -> int:
         """Drop every entry of one kind (keys are ``(kind, …)`` tuples:
@@ -128,7 +180,10 @@ class ServeCache:
         lets a serve process (or bench) shed one class of state — e.g.
         keep the prepared hybrid delta but force joinside
         re-preparation, or drop compiled fused-pipeline plans after a
-        config change — without a full clear."""
+        config change — without a full clear. The victim list is built
+        AND drained under the one cache lock, so a racing ``put`` of
+        the same kind either lands before the snapshot (and is evicted)
+        or after the drain (and survives) — never half-accounted."""
         with self._lock:
             victims = [
                 k
@@ -143,6 +198,32 @@ class ServeCache:
     @property
     def resident_bytes(self) -> int:
         return self._bytes
+
+    def bytes_by_kind(self) -> dict:
+        """Resident bytes per entry kind — the governor's breakdown
+        telemetry (which class of state owns the budget)."""
+        with self._lock:
+            out: dict = {}
+            for k, (_v, nbytes) in self._entries.items():
+                kind = k[0] if isinstance(k, tuple) and k else "other"
+                out[kind] = out.get(kind, 0) + nbytes
+            return out
+
+    def stats(self) -> dict:
+        """One consistent snapshot of the governor's counters (taken
+        under the lock, so bytes/entries/high-water agree)."""
+        with self._lock:
+            return {
+                "resident_bytes": self._bytes,
+                "high_water_bytes": self.high_water_bytes,
+                "max_bytes": self.max_bytes,
+                "entries": len(self._entries),
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "evicted_bytes": self.evicted_bytes,
+                "insert_failures": self.insert_failures,
+            }
 
     def __len__(self) -> int:
         return len(self._entries)

@@ -25,6 +25,7 @@ import pyarrow.parquet as pq
 
 from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.io.columnar import ColumnarBatch
+from hyperspace_tpu.testing import faults
 
 _BUCKET_FILE_RE = re.compile(r"part-\d+-bucket_(\d+)\.parquet$")
 
@@ -117,6 +118,15 @@ def read_table(
     ``__hs_nested.``-prefixed columns that are not literal flat columns
     in the files are served by reading the struct root and extracting
     the leaf (``_resolve_nested_columns``)."""
+    # fault-injection seam (testing/faults.py): every data read of the
+    # serve path funnels through here or read_file_row_groups; the serve
+    # frontend's retry/degrade under an armed "parquet_read" point is
+    # the tested robustness contract (docs/serve-server.md). The detail
+    # is the whole path list — a match= filter fires whichever position
+    # the matching file occupies — passed as-is: check() stringifies it
+    # only when the point is armed, so the disarmed hot path stays at
+    # one dict truthiness check.
+    faults.check("parquet_read", paths)
     if columns:
         read_cols, extract = _resolve_nested_columns(paths, columns, fmt)
         if extract:
@@ -253,6 +263,7 @@ def read_file_row_groups(
     (``execution/pipeline_compiler._run_chunked``). Kept as the single
     definition so the fused pass and the interpreted chain can never
     read different bytes."""
+    faults.check("parquet_read", path)
     pf = pq.ParquetFile(path)
     if groups is None:
         return pf.read(columns=cols)

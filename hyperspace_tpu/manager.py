@@ -145,10 +145,16 @@ class CachingIndexCollectionManager(IndexCollectionManager):
     def get_indexes(self, states: Optional[List[str]] = None) -> List[IndexLogEntry]:
         expiry = self.session.conf.cache_expiry_seconds
         now = time.time()
-        if self._cache is None or now - self._cached_at > expiry:
-            self._cache = super().get_indexes(None)
-            self._cached_at = now
+        # snapshot the cache slot ONCE: serve-frontend workers call this
+        # concurrently with a lifecycle action's clear_cache() (sets
+        # _cache = None); re-reading self._cache after the staleness
+        # check could observe that None and crash. Racing refreshes at
+        # worst duplicate the listing — both results are valid snapshots.
         entries = self._cache
+        if entries is None or now - self._cached_at > expiry:
+            entries = super().get_indexes(None)
+            self._cache = entries
+            self._cached_at = now
         if states is None:
             return list(entries)
         return [e for e in entries if e.state in states]

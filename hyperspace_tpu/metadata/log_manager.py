@@ -22,6 +22,7 @@ from hyperspace_tpu.constants import (
     States,
 )
 from hyperspace_tpu.metadata.entry import IndexLogEntry
+from hyperspace_tpu.testing import faults
 from hyperspace_tpu.utils import files as file_utils
 from hyperspace_tpu.utils import json_utils
 
@@ -44,6 +45,11 @@ class IndexLogManager:
     # -- reads --------------------------------------------------------------
     def get_log(self, log_id: int) -> Optional[IndexLogEntry]:
         p = self._path_for(log_id)
+        # fault-injection seam (testing/faults.py, "log_read"): the serve
+        # frontend's snapshot pinning reads logs through here; an armed
+        # point exercises its retry (transient) and serve-without-indexes
+        # degrade (persistent) paths
+        faults.check("log_read", p)
         if not os.path.isfile(p):
             return None
         return IndexLogEntry.from_dict(json_utils.from_json(file_utils.read_text(p)))
@@ -63,6 +69,7 @@ class IndexLogManager:
         """latestStable pointer, else scan ids backwards for a stable state
         (getLatestStableLog:102-127)."""
         p = self._latest_stable_path
+        faults.check("log_read", p)
         if os.path.isfile(p):
             entry = IndexLogEntry.from_dict(
                 json_utils.from_json(file_utils.read_text(p))

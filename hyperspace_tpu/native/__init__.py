@@ -34,6 +34,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from hyperspace_tpu.testing import faults as _faults
+
 _log = logging.getLogger("hyperspace_tpu.native")
 
 _SRC = os.path.join(os.path.dirname(__file__), "hs_native.cpp")
@@ -262,6 +264,13 @@ def load(wait: bool = True):
     seconds a background pre-warm (``HyperspaceSession`` startup) needs,
     rather than stalling a query on the one-time g++ run."""
     global _lib, _load_failed
+    # Fault-injection seam (testing/faults.py, "kernel_dispatch"): every
+    # kernel wrapper begins with load(wait=False), and None from a
+    # wrapper IS the registered degrade path — the numpy/interpreted
+    # twin (KERNEL_TWINS) with identical output. One choke point covers
+    # every native dispatch, generalizing the lexsort rc-2 fallback.
+    if _faults.degraded("kernel_dispatch"):
+        return None
     if _lib is not None or _load_failed:
         return _lib
     # Lock-held I/O is the point here: the one-time g++ compile and CDLL

@@ -36,11 +36,22 @@ def hyperspace_rule_disabled():
         _local.disabled = prev
 
 
-def apply_hyperspace(session, plan: LogicalPlan) -> LogicalPlan:
+def apply_hyperspace(
+    session, plan: LogicalPlan, entries=None
+) -> LogicalPlan:
+    """Rewrite ``plan`` against the ACTIVE index entries.
+
+    ``entries`` pins the candidate set: the concurrent serve frontend
+    (``serve/frontend.py``) captures the latestStable entries ONCE at
+    query admission and passes them here, so a refresh/optimize landing
+    mid-query can never mix index versions inside one rewrite. None =
+    read the current entries (the single-query embedding path, where
+    one ``execute()`` is one snapshot anyway)."""
     if getattr(_local, "disabled", False):
         return plan
     try:
-        entries = session.index_manager.get_indexes([States.ACTIVE])
+        if entries is None:
+            entries = session.index_manager.get_indexes([States.ACTIVE])
         if not entries:
             return plan
         from hyperspace_tpu.plan.nodes import prune_join_columns

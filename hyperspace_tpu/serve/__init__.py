@@ -1,0 +1,11 @@
+"""Concurrent serve frontend (docs/serve-server.md).
+
+The long-lived, many-queries-one-process plane over the single-query
+engine: admission control (single-flight dedup + load shedding),
+snapshot-consistent index pinning, and retry/degrade at the operation
+boundary. See :mod:`hyperspace_tpu.serve.frontend`.
+"""
+
+from hyperspace_tpu.serve.frontend import ServeFrontend, plan_fingerprint
+
+__all__ = ["ServeFrontend", "plan_fingerprint"]

@@ -1,0 +1,316 @@
+"""ServeFrontend — the concurrent serve plane (docs/serve-server.md).
+
+Everything below this module executes ONE query; a process "serving
+millions of users" is measured under contention. The frontend owns that
+boundary:
+
+* **Admission control.** Identical in-flight plans are deduplicated
+  (single-flight by :func:`plan_fingerprint` + config version + pinned
+  snapshot — N clients asking the same question cost one execution),
+  and queries queued past ``hyperspace.serve.maxQueueDepth`` are shed
+  with a typed :class:`ServeOverloadedError` at submit time, before any
+  work is buffered.
+
+* **Snapshot-consistent serving.** At admission each query pins the
+  set of latestStable ACTIVE log entries (``metadata/log_manager.py``;
+  one read, one consistent set) and the rewrite runs against that pin
+  (``rules/apply.apply_hyperspace(entries=…)``) — a ``refresh`` /
+  ``optimize`` / ``vacuum`` landing mid-query can never mix index
+  versions inside one query. Index version file sets are immutable, so
+  the pinned plan stays readable until a vacuum physically removes the
+  old version — which surfaces as an I/O error and is healed by the
+  retry below (re-pin + re-plan on the current snapshot).
+
+* **Retry / degrade at the operation boundary** (Exoshuffle doctrine:
+  fault handling belongs in the application-level dataflow). TRANSIENT
+  failures — real I/O errors, vacuumed-under-us files, or injected
+  ``testing/faults.py`` faults — retry with exponential backoff
+  (``hyperspace.serve.retry.*``), re-pinning the snapshot each attempt.
+  PERSISTENT I/O failures of an index-rewritten query degrade to the
+  unrewritten plan (serve straight from the source data — slower,
+  bit-identical). Native-kernel faults never reach this module: every
+  kernel dispatch degrades in place to its registered numpy/interpreted
+  twin (``KERNEL_TWINS``, ``native.load``). Failing cache inserts are
+  dropped in place (``ServeCache.insert_failures``). The result is the
+  fault matrix the tests pin down: for every injection point ×
+  {transient, persistent}, a serve either retries to a bit-identical
+  result or degrades to an identical-output path — never a wrong
+  answer, never a hung query.
+
+Threading: queries run on the frontend's own pool (``hs-serve-*``).
+Per-bucket parquet reads still go to the shared ``io/scan.scan_pool``
+— serve workers BLOCK on scan futures, scan workers never block on
+serve futures, so the two pools cannot deadlock (the scan pool's
+documented discipline). One frontend lock guards admission state and
+counters; nothing blocking and no I/O runs under it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import List, Optional, Tuple
+
+from hyperspace_tpu.constants import States
+from hyperspace_tpu.exceptions import (
+    HyperspaceException,
+    ServeOverloadedError,
+)
+from hyperspace_tpu.plan.nodes import LogicalPlan
+from hyperspace_tpu.testing.faults import InjectedFault
+
+
+def plan_fingerprint(plan: LogicalPlan) -> Tuple:
+    """Identity of a logical plan for single-flight purposes: the node
+    structure (``repr`` covers operators, conditions, projections) plus
+    each leaf relation's concrete file snapshot — two scans of the same
+    directory at different snapshots must not coalesce."""
+    leaves = tuple(
+        (
+            leaf.relation.files,
+            leaf.relation.fmt,
+            leaf.relation.excluded_file_ids,
+            leaf.relation.options,
+        )
+        for leaf in plan.collect_leaves()
+    )
+    return (repr(plan), leaves)
+
+
+def _is_transient(exc: BaseException) -> bool:
+    """Retryable? Injected faults carry the answer; every real OSError
+    (missing file after a concurrent vacuum, flaky storage, Arrow I/O
+    errors — OSError subclasses in pyarrow) is worth the retry budget.
+    Engine errors (HyperspaceException et al.) are deterministic and
+    retry would just repeat them."""
+    if isinstance(exc, InjectedFault):
+        return exc.transient
+    return isinstance(exc, OSError)
+
+
+class ServeFrontend:
+    """Long-lived concurrent query frontend over one session.
+
+    Usage (also ``session.serve_frontend`` for a shared instance)::
+
+        fe = session.serve_frontend
+        table = fe.serve(df)             # blocking
+        fut = fe.submit(df)              # Future[pyarrow.Table]
+
+    Results are shared between deduplicated callers — pyarrow Tables
+    are immutable, so sharing is safe.
+    """
+
+    def __init__(self, session):
+        self._session = session
+        self._max_queue = session.conf.serve_max_queue_depth
+        self.max_concurrency = session.conf.serve_max_concurrency
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.max_concurrency,
+            thread_name_prefix="hs-serve",
+        )
+        self._lock = threading.Lock()
+        self._inflight: dict = {}
+        self._queued = 0
+        self._closed = False
+        # counters (read via stats(); all mutated under _lock)
+        self._admitted = 0
+        self._completed = 0
+        self._deduped = 0
+        self._shed = 0
+        self._retries = 0
+        self._degraded = 0
+        self._degraded_pins = 0
+        self._failed = 0
+        self._latencies: deque = deque(maxlen=4096)
+
+    # -- snapshot pinning ---------------------------------------------------
+    def _pin(self) -> Optional[Tuple]:
+        """The latestStable ACTIVE entries, captured once — the query's
+        index snapshot. Transient log-read failures retry inline with
+        the serve backoff; a persistent failure degrades to pin=None
+        (serve without indexes: correct, slower), because a dead
+        metadata store must not take query serving down with it."""
+        session = self._session
+        if not session.is_hyperspace_enabled() or not session.conf.apply_enabled:
+            return ()
+        attempts = session.conf.serve_retry_max_attempts
+        backoff = session.conf.serve_retry_backoff_ms / 1000.0
+        for attempt in range(attempts):
+            try:
+                return tuple(
+                    session.index_manager.get_indexes([States.ACTIVE])
+                )
+            # catch-all IS the contract: pin failure of any shape must
+            # degrade to serving without indexes, never fail the query
+            except Exception as exc:  # hslint: disable=HS402
+                if not _is_transient(exc) or attempt + 1 >= attempts:
+                    with self._lock:
+                        self._degraded_pins += 1
+                    return None
+                with self._lock:
+                    self._retries += 1
+                if backoff > 0:
+                    time.sleep(backoff * (1 << attempt))
+        return None
+
+    # -- admission ----------------------------------------------------------
+    def submit(self, query) -> Future:
+        """Admit one query (DataFrame or LogicalPlan). Returns a Future
+        resolving to the pyarrow Table. Raises
+        :class:`ServeOverloadedError` when the pending queue is full —
+        nothing is buffered for a shed query."""
+        plan = getattr(query, "logical_plan", query)
+        if not isinstance(plan, LogicalPlan):
+            raise HyperspaceException(
+                f"serve() takes a DataFrame or LogicalPlan, got {type(query)}"
+            )
+        # shed BEFORE pinning: an overloaded frontend must reject in
+        # O(1) with no metadata I/O and no backoff sleeps on the caller
+        # thread — that cheap typed rejection is the whole point of the
+        # bound. The cost is that a shed query never gets the chance to
+        # dedup onto an in-flight twin; under overload that trade is
+        # the documented contract. Depth is re-checked at enqueue (the
+        # pin read dropped the lock in between).
+        with self._lock:
+            self._check_admittable()
+        pin = self._pin()
+        fp = (
+            plan_fingerprint(plan),
+            self._session.conf.version,
+            None
+            if pin is None
+            else tuple((e.name, e.id) for e in pin),
+        )
+        with self._lock:
+            existing = self._inflight.get(fp)
+            if existing is not None:
+                self._deduped += 1
+                return existing
+            self._check_admittable()
+            self._queued += 1
+            self._admitted += 1
+            fut = self._pool.submit(self._run, plan, pin)
+            self._inflight[fp] = fut
+        fut.add_done_callback(lambda _f, fp=fp: self._forget(fp))
+        return fut
+
+    def _check_admittable(self) -> None:
+        """Raise unless a new query may enter (call with the lock held)."""
+        if self._closed:
+            raise HyperspaceException("ServeFrontend is closed")
+        if self._max_queue > 0 and self._queued >= self._max_queue:
+            self._shed += 1
+            raise ServeOverloadedError(
+                f"serve queue full ({self._queued} pending >= "
+                f"maxQueueDepth {self._max_queue}); shedding"
+            )
+
+    def serve(self, query):
+        """Blocking convenience: submit and wait."""
+        return self.submit(query).result()
+
+    def _forget(self, fp) -> None:
+        with self._lock:
+            self._inflight.pop(fp, None)
+
+    # -- execution ----------------------------------------------------------
+    def _execute_pinned(self, plan: LogicalPlan, pin: Optional[Tuple]):
+        from hyperspace_tpu.execution import execute
+        from hyperspace_tpu.rules.apply import apply_hyperspace
+
+        session = self._session
+        optimized = plan
+        if pin:
+            optimized = apply_hyperspace(session, plan, entries=list(pin))
+        return execute(optimized, session)
+
+    def _run(self, plan: LogicalPlan, pin: Optional[Tuple]):
+        with self._lock:
+            self._queued -= 1
+        session = self._session
+        attempts = session.conf.serve_retry_max_attempts
+        backoff = session.conf.serve_retry_backoff_ms / 1000.0
+        t_start = time.perf_counter()
+        attempt = 1
+        while True:
+            try:
+                out = self._execute_pinned(plan, pin)
+                self._record(t_start)
+                return out
+            except Exception as exc:  # classified below; always re-raised
+                if _is_transient(exc) and attempt < attempts:
+                    attempt += 1
+                    with self._lock:
+                        self._retries += 1
+                    if backoff > 0:
+                        time.sleep(backoff * (1 << (attempt - 2)))
+                    # re-pin: a vacuum may have removed the pinned
+                    # version's files; the current snapshot serves
+                    pin = self._pin()
+                    continue
+                if isinstance(exc, OSError) and pin:
+                    # persistent I/O failure of the index-rewritten
+                    # query: degrade to the unrewritten plan (source
+                    # data; bit-identical result — the covering-index
+                    # equivalence the differential suite guarantees)
+                    with self._lock:
+                        self._degraded += 1
+                    try:
+                        out = self._execute_pinned(plan, ())
+                    except Exception:
+                        with self._lock:
+                            self._failed += 1
+                        raise exc from None
+                    self._record(t_start)
+                    return out
+                with self._lock:
+                    self._failed += 1
+                raise
+
+    def _record(self, t_start: float) -> None:
+        dt = time.perf_counter() - t_start
+        with self._lock:
+            self._completed += 1
+            self._latencies.append(dt)
+
+    # -- introspection / lifecycle ------------------------------------------
+    def stats(self) -> dict:
+        """One consistent snapshot of the frontend counters, plus p50/p99
+        over the most recent completions (seconds)."""
+        with self._lock:
+            lat: List[float] = sorted(self._latencies)
+            out = {
+                "admitted": self._admitted,
+                "completed": self._completed,
+                "deduped": self._deduped,
+                "shed": self._shed,
+                "retries": self._retries,
+                "degraded": self._degraded,
+                "degraded_pins": self._degraded_pins,
+                "failed": self._failed,
+                "queued": self._queued,
+                "inflight": len(self._inflight),
+                "max_concurrency": self.max_concurrency,
+            }
+        if lat:
+            out["p50_s"] = lat[len(lat) // 2]
+            out["p99_s"] = lat[min(len(lat) - 1, (len(lat) * 99) // 100)]
+        return out
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self, wait: bool = True) -> None:
+        with self._lock:
+            self._closed = True
+        self._pool.shutdown(wait=wait)
+
+    def __enter__(self) -> "ServeFrontend":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

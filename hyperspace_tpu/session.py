@@ -133,6 +133,8 @@ class HyperspaceSession:
         self._index_manager = None
         self._serve_cache = None
         self._serve_cache_lock = threading.Lock()
+        self._serve_frontend = None
+        self._serve_frontend_lock = threading.Lock()
         self._catalog: dict = {}
         # Pre-warm the native host kernels off-thread: the one-time g++
         # compile (~2s, cached per machine) then lands during session
@@ -191,6 +193,23 @@ class HyperspaceSession:
     def clear_serve_cache(self) -> None:
         if self._serve_cache is not None:
             self._serve_cache.clear()
+
+    @property
+    def serve_frontend(self):
+        """The session's long-lived concurrent serve frontend
+        (``serve/frontend.py``): admission control, snapshot-consistent
+        pinning, retry/degrade. Created lazily; pool size is read from
+        ``hyperspace.serve.maxConcurrency`` at first touch (construct a
+        :class:`~hyperspace_tpu.serve.ServeFrontend` directly for a
+        differently-sized or short-lived one). A closed frontend is
+        discarded and replaced on the next touch — ``close()`` must not
+        brick serving on the session forever."""
+        with self._serve_frontend_lock:
+            if self._serve_frontend is None or self._serve_frontend.closed:
+                from hyperspace_tpu.serve import ServeFrontend
+
+                self._serve_frontend = ServeFrontend(self)
+            return self._serve_frontend
 
     # -- reading ------------------------------------------------------------
     @property

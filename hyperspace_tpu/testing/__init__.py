@@ -1,0 +1,6 @@
+"""Test/robustness harnesses shipped with the package.
+
+``testing.faults`` is imported by production modules (the injection
+points), so everything in this package must stay stdlib-only and
+import-cheap — it is on the cold-start path of ``hyperspace_tpu.native``.
+"""

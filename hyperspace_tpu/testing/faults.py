@@ -1,0 +1,217 @@
+"""Config-keyed fault injection for the serve plane.
+
+Exoshuffle (PAPERS.md) argues fault handling belongs in the
+application-level dataflow — retried or degraded at the operation
+boundary — not bolted underneath it. To make that testable, the four
+I/O-and-dispatch seams of the serve path carry an injection point each:
+
+========================  ====================================================
+point                     armed site
+========================  ====================================================
+``parquet_read``          ``io/parquet.read_table`` / ``read_file_row_groups``
+                          (every data read, incl. scan-pool workers and the
+                          fused pipeline's chunk reads)
+``kernel_dispatch``       ``native.load(wait=False)`` — the single choke point
+                          every native kernel wrapper passes through; a fired
+                          fault makes the wrapper return None, which IS the
+                          registered degrade path (numpy/interpreted twin,
+                          ``KERNEL_TWINS``)
+``log_read``              ``metadata/log_manager.py`` log-entry and
+                          latestStable reads (snapshot pinning)
+``cache_insert``          ``ServeCache.put`` — a fired fault drops the insert
+                          (query still answers, just uncached; counted in
+                          ``ServeCache.insert_failures``)
+========================  ====================================================
+
+Arming is always an explicit act: programmatic (:func:`set_fault`) or
+config-keyed via ``faults.configure(session.conf)``, which reads the
+``hyperspace.faults.<point>`` keys — merely setting the conf keys arms
+nothing (production never injects into itself). Spec grammar::
+
+    "transient"            fail the next 1 matching call, then recover
+    "transient:3"          fail the next 3 matching calls, then recover
+    "persistent"           fail every matching call until cleared
+    "persistent;match=v__="  only calls whose detail (e.g. file path)
+                           contains the substring — lets a test fail
+                           index-version reads while source reads and the
+                           degrade path keep working
+    "off" / ""             disarm
+
+Semantics at the site: ``check`` raises :class:`InjectedFault` (an
+``OSError``, so the serve frontend's transient-I/O classification treats
+injected and real faults identically); ``degraded`` returns True for
+sites whose contract is fall-back-in-place rather than raise (kernel
+dispatch, cache insert). ``transient``-armed faults recover on their
+own; ``persistent`` ones model a dead dependency and exercise the
+degrade paths. Per-point fired counters (:func:`stats`) let the test
+suite and ``scripts/bench_smoke.sh`` assert each point actually fired.
+
+Everything is process-global and thread-safe: the serve plane is
+multi-threaded and a fault armed by the admitting thread must fire in
+scan-pool workers. When nothing is armed the per-call cost is one dict
+truthiness check.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+POINTS = ("parquet_read", "kernel_dispatch", "log_read", "cache_insert")
+
+
+class InjectedFault(OSError):
+    """A fault fired by an armed injection point.
+
+    Subclasses ``OSError`` on purpose: the transient flavor must travel
+    the exact classification path a real transient I/O error takes
+    (``serve/frontend._is_transient``), so the retry machinery tested
+    under injection is the machinery production errors hit.
+    """
+
+    def __init__(self, point: str, transient: bool):
+        kind = "transient" if transient else "persistent"
+        super().__init__(f"injected {kind} fault at {point}")
+        self.point = point
+        self.transient = transient
+
+
+class _FaultPoint:
+    """One armed point: remaining budget (None = unlimited), substring
+    filter, fired counter. ``fire`` is the only mutator and holds the
+    registry lock for its counter updates."""
+
+    def __init__(
+        self,
+        point: str,
+        transient: bool,
+        remaining: Optional[int],
+        match: Optional[str],
+    ):
+        self.point = point
+        self.transient = transient
+        self.remaining = remaining
+        self.match = match
+        self.fired = 0
+
+    def fire(self, detail: str) -> bool:
+        if self.match and self.match not in detail:
+            return False
+        with _lock:
+            if self.remaining is not None:
+                if self.remaining <= 0:
+                    return False
+                self.remaining -= 1
+            self.fired += 1
+            _fired_totals[self.point] = _fired_totals.get(self.point, 0) + 1
+        return True
+
+
+_lock = threading.Lock()
+_active: Dict[str, _FaultPoint] = {}
+# totals survive disarm/re-arm so a suite can assert "every point fired
+# at least once" at the end of a run that armed points one at a time
+_fired_totals: Dict[str, int] = {}
+
+
+def parse_spec(spec: str):
+    """``(transient, remaining, match)`` from a spec string, or None for
+    off/empty. Raises ValueError on a malformed spec — arming is always
+    an explicit test/operator act, so a typo should be loud."""
+    s = str(spec).strip()
+    if not s or s.lower() == "off":
+        return None
+    match = None
+    parts = s.split(";")
+    for opt in parts[1:]:
+        k, _, v = opt.partition("=")
+        if k.strip() == "match" and v:
+            match = v
+        else:
+            raise ValueError(f"bad fault option {opt!r} in {spec!r}")
+    head = parts[0].strip().lower()
+    mode, _, count = head.partition(":")
+    if mode == "transient":
+        remaining = int(count) if count else 1
+        if remaining <= 0:
+            raise ValueError(f"transient count must be positive: {spec!r}")
+        return True, remaining, match
+    if mode == "persistent":
+        if count:
+            raise ValueError(f"persistent takes no count: {spec!r}")
+        return False, None, match
+    raise ValueError(f"unknown fault mode {mode!r} in {spec!r}")
+
+
+def set_fault(point: str, spec: str) -> bool:
+    """Arm (or disarm, spec="off") one injection point. Returns True
+    when the point was armed, False when disarmed."""
+    if point not in POINTS:
+        raise ValueError(f"unknown fault point {point!r}; have {POINTS}")
+    parsed = parse_spec(spec)
+    with _lock:
+        if parsed is None:
+            _active.pop(point, None)
+            return False
+        transient, remaining, match = parsed
+        _active[point] = _FaultPoint(point, transient, remaining, match)
+        return True
+
+
+def configure(conf) -> int:
+    """Arm every ``hyperspace.faults.<point>`` key present in a session
+    config (:meth:`Config.prefixed`). Returns the number of armed
+    points. Unlisted points are left untouched — call :func:`clear`
+    first for a clean slate."""
+    from hyperspace_tpu.constants import FAULTS_KEY_PREFIX
+
+    n = 0
+    for key, spec in conf.prefixed(FAULTS_KEY_PREFIX).items():
+        point = key[len(FAULTS_KEY_PREFIX):]
+        if set_fault(point, str(spec)):
+            n += 1
+    return n
+
+
+def clear() -> None:
+    """Disarm every point (fired totals are kept; see module doc)."""
+    with _lock:
+        _active.clear()
+
+
+def reset() -> None:
+    """Disarm every point AND zero the fired totals (test isolation)."""
+    with _lock:
+        _active.clear()
+        _fired_totals.clear()
+
+
+def check(point: str, detail="") -> None:
+    """Raise :class:`InjectedFault` when ``point`` is armed and fires.
+
+    The raising flavor — for sites whose real failure mode is an
+    exception (reads). No-op (one dict check) when nothing is armed;
+    ``detail`` may be any object (e.g. a path list) — it is stringified
+    only when the point is armed, so disarmed call sites pay nothing.
+    """
+    if not _active:
+        return
+    fp = _active.get(point)
+    if fp is not None and fp.fire(str(detail)):
+        raise InjectedFault(point, fp.transient)
+
+
+def degraded(point: str, detail="") -> bool:
+    """True when ``point`` is armed and fires — the non-raising flavor
+    for sites whose contract is degrade-in-place (kernel dispatch falls
+    back to the numpy twin, cache insert is dropped)."""
+    if not _active:
+        return False
+    fp = _active.get(point)
+    return fp is not None and fp.fire(str(detail))
+
+
+def stats() -> Dict[str, int]:
+    """Cumulative fired count per point (across disarm/re-arm)."""
+    with _lock:
+        return dict(_fired_totals)

@@ -46,6 +46,14 @@ def atomic_write_if_absent(path: str, text: str) -> bool:
     try:
         with os.fdopen(fd, "w", encoding="utf-8") as f:
             f.write(text)
+            # fsync BEFORE the link publishes the name (the
+            # calibrate._store_cache pattern, docs/static-analysis.md):
+            # on a journaled filesystem a crash between write and
+            # publish must never leave a torn/empty log entry visible
+            # under its final name — readers treat an existing entry as
+            # complete JSON (get_log has no partial-read recovery).
+            f.flush()
+            os.fsync(f.fileno())
         try:
             os.link(tmp, path)
             return True
@@ -53,9 +61,14 @@ def atomic_write_if_absent(path: str, text: str) -> bool:
             return False
         except OSError:
             # Hard links unsupported (FUSE object-store mounts): O_EXCL path.
+            # No atomic-content guarantee exists here at all (the name is
+            # visible while the content streams); fsync at least bounds
+            # the crash window to the write itself on those mounts.
             try:
                 with open(path, "x", encoding="utf-8") as f:
                     f.write(text)
+                    f.flush()
+                    os.fsync(f.fileno())
                 return True
             except FileExistsError:
                 return False
@@ -64,13 +77,20 @@ def atomic_write_if_absent(path: str, text: str) -> bool:
 
 
 def atomic_overwrite(path: str, text: str) -> None:
-    """Atomically replace ``path`` with ``text`` (latestStable pointer)."""
+    """Atomically replace ``path`` with ``text`` (latestStable pointer).
+
+    fsync-before-replace, like :func:`atomic_write_if_absent`: a crash
+    right after the rename must not publish an empty pointer file (the
+    rename can be journaled before the data on ext4/xfs without it).
+    """
     d = os.path.dirname(path)
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp_log_")
     try:
         with os.fdopen(fd, "w", encoding="utf-8") as f:
             f.write(text)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
     except BaseException:
         try:

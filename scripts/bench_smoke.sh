@@ -16,6 +16,11 @@ if [ "$ROWS" -gt 100000 ]; then
     echo "bench_smoke: capping rows at 100000 (got $ROWS)" >&2
     ROWS=100000
 fi
+# The slow-marked serve stress suite (64 clients, budgeted cache,
+# concurrent refresh) is excluded from tier-1 to keep it fast; it runs
+# here so every CI pass exercises the contention rungs.
+JAX_PLATFORMS=cpu python -m pytest tests/test_serve_stress.py -q -m slow \
+    -p no:cacheprovider
 OUT=$(JAX_PLATFORMS=cpu \
 HS_BENCH_FORCE_CPU_DEVICES=8 \
 HS_BENCH_ROWS="$ROWS" \
@@ -47,6 +52,28 @@ for row in ("filter_agg", "grouped_agg"):
 assert d["grouped_agg"]["stats"]["groups"] > 1, d["grouped_agg"]
 print("bench_smoke: fused pipeline ok:", d["filter_agg"]["stats"],
       d["grouped_agg"]["stats"], file=sys.stderr)
+# the concurrent serve frontend must have run its contention ladder
+# (incl. the 8- and 64-client rungs) with the cache budget holding, and
+# the fault-injection rung must have fired EVERY injection point at
+# least once with zero frontend failures (retry/degrade answered
+# bit-identically — the asserts live in bench.py; here we require the
+# evidence that they ran)
+sc = {r["clients"]: r for r in d["serve_concurrency"]}
+for clients in (1, 8, 64):
+    r = sc[clients]
+    assert r["queries"] == clients * 8, r
+    assert r["p50_ms"] > 0 and r["p99_ms"] >= r["p50_ms"], r
+    assert r["qps"] > 0, r
+    assert r["cache_high_water_bytes"] <= r["cache_max_bytes"], r
+fi = d["fault_injection"]
+for point in ("parquet_read", "kernel_dispatch", "log_read", "cache_insert"):
+    assert fi["fired"].get(point, 0) >= 1, (point, fi)
+assert fi["frontend_failed"] == 0, fi
+assert fi["frontend_retries"] >= 1 and fi["frontend_degraded"] >= 1, fi
+print("bench_smoke: serve concurrency ok:",
+      {c: (sc[c]["p50_ms"], sc[c]["p99_ms"], sc[c]["qps"]) for c in sc},
+      file=sys.stderr)
+print("bench_smoke: fault matrix ok:", fi, file=sys.stderr)
 mesh = d["mesh_ladder"]
 assert mesh, "mesh ladder rows missing"
 multi = [r for r in mesh if r["devices"] > 1]

@@ -1,0 +1,241 @@
+"""Fault-injection harness + the serve fault matrix.
+
+The contract (ISSUE 8 / docs/serve-server.md): for each injection point
+(parquet read, kernel dispatch, log read, cache insert) × {transient,
+persistent}, a serve through the frontend either RETRIES to a
+bit-identical result or DEGRADES to a path with identical output —
+never a wrong answer, never a hung query. Every leg also asserts its
+point actually fired (``faults.stats()``), so a refactor that silently
+bypasses an injection seam fails here, not in production.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu import functions as hsf
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+from hyperspace_tpu.testing import faults
+from hyperspace_tpu.testing.faults import InjectedFault
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class TestFaultRegistry:
+    def test_spec_parsing(self):
+        assert faults.parse_spec("off") is None
+        assert faults.parse_spec("") is None
+        assert faults.parse_spec("transient") == (True, 1, None)
+        assert faults.parse_spec("transient:3") == (True, 3, None)
+        assert faults.parse_spec("persistent") == (False, None, None)
+        assert faults.parse_spec("persistent;match=v__=") == (
+            False,
+            None,
+            "v__=",
+        )
+        for bad in ("sometimes", "transient:0", "persistent:2", "transient;x=1"):
+            with pytest.raises(ValueError):
+                faults.parse_spec(bad)
+        with pytest.raises(ValueError):
+            faults.set_fault("not_a_point", "transient")
+
+    def test_transient_budget_and_match(self):
+        faults.set_fault("log_read", "transient:2;match=special")
+        # non-matching detail never fires
+        faults.check("log_read", "/other/path")
+        with pytest.raises(InjectedFault) as ei:
+            faults.check("log_read", "/special/path")
+        assert ei.value.transient and ei.value.point == "log_read"
+        with pytest.raises(InjectedFault):
+            faults.check("log_read", "also special")
+        # budget exhausted: recovered
+        faults.check("log_read", "special again")
+        assert faults.stats() == {"log_read": 2}
+
+    def test_degraded_flavor_and_config_keyed_arming(self):
+        from hyperspace_tpu.config import Config
+
+        conf = Config()
+        conf.set(C.FAULTS_KEY_PREFIX + "kernel_dispatch", "persistent")
+        conf.set(C.FAULTS_KEY_PREFIX + "cache_insert", "off")
+        assert faults.configure(conf) == 1
+        assert faults.degraded("kernel_dispatch")
+        assert faults.degraded("kernel_dispatch")  # persistent: every call
+        assert not faults.degraded("cache_insert")
+        faults.clear()
+        assert not faults.degraded("kernel_dispatch")
+        # cumulative totals survive clear()
+        assert faults.stats()["kernel_dispatch"] == 2
+
+    def test_injected_fault_is_oserror(self):
+        # the transient classification path must treat injected and real
+        # I/O faults identically (serve/frontend._is_transient)
+        assert issubclass(InjectedFault, OSError)
+
+
+# ---------------------------------------------------------------------------
+# The serve fault matrix
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def served(session_factory, tmp_path):
+    """One-device session, small indexed table, serve frontend, plus the
+    fault-free baseline results computed up front (serial, no frontend)."""
+    s = session_factory(1)
+    d = tmp_path / "events"
+    d.mkdir()
+    rng = np.random.default_rng(3)
+    n = 24_000
+    t = pa.table(
+        {
+            "k": pa.array(rng.integers(0, 600, n), pa.int64()),
+            "v": pa.array(rng.normal(0.0, 1.0, n)),
+            "q": pa.array(rng.integers(1, 50, n), pa.int64()),
+        }
+    )
+    for i in range(3):
+        pq.write_table(t.slice(i * n // 3, n // 3), str(d / f"p{i}.parquet"))
+    s.conf.set(C.INDEX_FILTER_RULE_USE_BUCKET_SPEC, True)
+    hs = Hyperspace(s)
+    df = s.read.parquet(str(d))
+    hs.create_index(df, CoveringIndexConfig("i1", ["k"], ["v", "q"]))
+    s.enable_hyperspace()
+
+    def q_point(key=7):
+        return df.filter(df["k"] == key).select("v", "q")
+
+    def q_agg():
+        return df.filter((df["k"] >= 100) & (df["k"] < 300)).agg(
+            hsf.count().alias("n"), hsf.sum("q").alias("sq")
+        )
+
+    baselines = {
+        "point": s.execute(q_point().logical_plan),
+        "agg": s.execute(q_agg().logical_plan),
+    }
+    fe = s.serve_frontend
+    yield s, fe, q_point, q_agg, baselines
+    fe.close()
+
+
+def _assert_bit_identical(got: pa.Table, want: pa.Table):
+    assert got.schema.equals(want.schema)
+    assert got.equals(want), (got.to_pydict(), want.to_pydict())
+
+
+class TestFaultMatrix:
+    @pytest.mark.parametrize("spec", ["transient:1", "transient:3"])
+    def test_parquet_read_transient_retries(self, served, spec):
+        s, fe, q_point, _q_agg, base = served
+        faults.set_fault("parquet_read", spec)
+        out = fe.serve(q_point())
+        _assert_bit_identical(out, base["point"])
+        assert faults.stats()["parquet_read"] >= 1
+        assert fe.stats()["retries"] >= 1
+        assert fe.stats()["failed"] == 0
+
+    def test_parquet_read_persistent_degrades_to_source(self, served):
+        s, fe, q_point, _q_agg, base = served
+        # only INDEX data reads fail (version dirs are v__=N); the
+        # degrade path — the unrewritten plan over source files — works
+        faults.set_fault("parquet_read", "persistent;match=v__=")
+        out = fe.serve(q_point())
+        _assert_bit_identical(out, base["point"])
+        assert faults.stats()["parquet_read"] >= 1
+        assert fe.stats()["degraded"] >= 1
+
+    def test_parquet_read_persistent_everywhere_fails_typed(self, served):
+        # no healthy path left: the query must fail with the typed
+        # injected fault — cleanly, not hang, and not a wrong answer
+        s, fe, q_point, _q_agg, _base = served
+        faults.set_fault("parquet_read", "persistent")
+        with pytest.raises(InjectedFault):
+            fe.serve(q_point())
+        assert fe.stats()["failed"] >= 1
+
+    @pytest.mark.parametrize("spec", ["transient:2", "persistent"])
+    def test_kernel_dispatch_degrades_to_twins(self, served, spec):
+        # every native kernel wrapper passes through load(wait=False);
+        # a fired fault returns None and the caller runs the registered
+        # numpy/interpreted twin (KERNEL_TWINS) — identical output with
+        # no frontend involvement at all
+        s, fe, q_point, q_agg, base = served
+        faults.set_fault("kernel_dispatch", spec)
+        _assert_bit_identical(fe.serve(q_agg()), base["agg"])
+        _assert_bit_identical(fe.serve(q_point()), base["point"])
+        assert faults.stats()["kernel_dispatch"] >= 1
+        assert fe.stats()["failed"] == 0
+        assert fe.stats()["degraded"] == 0  # degrade happened at dispatch
+
+    def test_log_read_transient_retries_pin(self, served):
+        s, fe, q_point, _q_agg, base = served
+        s.index_manager.clear_cache()  # force a real log read at pin time
+        faults.set_fault("log_read", "transient:1")
+        out = fe.serve(q_point())
+        _assert_bit_identical(out, base["point"])
+        assert faults.stats()["log_read"] >= 1
+        assert fe.stats()["failed"] == 0
+
+    def test_log_read_persistent_serves_without_indexes(self, served):
+        s, fe, q_point, _q_agg, base = served
+        s.index_manager.clear_cache()
+        faults.set_fault("log_read", "persistent")
+        out = fe.serve(q_point())
+        _assert_bit_identical(out, base["point"])
+        assert faults.stats()["log_read"] >= 1
+        assert fe.stats()["degraded_pins"] >= 1
+        assert fe.stats()["failed"] == 0
+
+    @pytest.mark.parametrize("spec", ["transient:1", "persistent"])
+    def test_cache_insert_drops_never_fails(self, served, spec):
+        s, fe, q_point, _q_agg, base = served
+        s.conf.set(C.SERVE_CACHE_ENABLED, True)
+        try:
+            faults.set_fault("cache_insert", spec)
+            _assert_bit_identical(fe.serve(q_point()), base["point"])
+            want11 = s.execute(q_point(key=11).logical_plan)
+            _assert_bit_identical(fe.serve(q_point(key=11)), want11)
+            cache = s.serve_cache
+            assert cache.insert_failures >= 1
+            assert faults.stats()["cache_insert"] >= 1
+            assert fe.stats()["failed"] == 0
+            if spec == "transient:1":
+                # recovered: later inserts land
+                fe.serve(q_point(key=13))
+                assert len(cache) >= 1
+        finally:
+            s.conf.set(C.SERVE_CACHE_ENABLED, False)
+            s.clear_serve_cache()
+
+    def test_every_point_fired_in_this_module(self, served):
+        # matrix completeness backstop: arm everything transiently, run
+        # one query per shape, and require all four points to have fired
+        # at least once in THIS test (budget sized for one serve each)
+        s, fe, q_point, q_agg, base = served
+        s.conf.set(C.SERVE_CACHE_ENABLED, True)
+        try:
+            s.index_manager.clear_cache()
+            s.clear_serve_cache()
+            faults.set_fault("parquet_read", "transient:1")
+            faults.set_fault("kernel_dispatch", "transient:1")
+            faults.set_fault("log_read", "transient:1")
+            faults.set_fault("cache_insert", "transient:1")
+            _assert_bit_identical(fe.serve(q_agg()), base["agg"])
+            _assert_bit_identical(fe.serve(q_point()), base["point"])
+            fired = faults.stats()
+            for point in faults.POINTS:
+                assert fired.get(point, 0) >= 1, (point, fired)
+        finally:
+            s.conf.set(C.SERVE_CACHE_ENABLED, False)
+            s.clear_serve_cache()

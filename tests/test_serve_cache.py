@@ -8,6 +8,7 @@ scans and refresh-driven invalidation.
 """
 
 import os
+import time
 
 import numpy as np
 import pyarrow as pa
@@ -686,3 +687,105 @@ class TestPublicationMerge:
         finally:
             cache.peek = real_peek
         session.conf.set(C.SERVE_CACHE_ENABLED, False)
+
+
+class TestMemoryGovernor:
+    """ServeCache as the serve plane's memory governor (ISSUE 8): exact
+    byte accounting, budget never exceeded — even observed racily — and
+    resident-set telemetry."""
+
+    def test_high_water_and_eviction_telemetry(self):
+        c = ServeCache(max_bytes=100)
+        c.put(("scan", "a"), 1, 60)
+        c.put(("joinside", "b"), 2, 40)
+        assert c.high_water_bytes == 100
+        c.put(("scan", "c"), 3, 30)  # evicts ("scan","a")
+        assert c.get(("scan", "a")) is None
+        st = c.stats()
+        assert st["evictions"] == 1 and st["evicted_bytes"] == 60
+        assert st["high_water_bytes"] == 100
+        assert st["resident_bytes"] == 70
+        assert c.bytes_by_kind() == {"joinside": 40, "scan": 30}
+
+    def test_put_never_overshoots_budget(self):
+        # eviction happens BEFORE insert: the ledger can never pass the
+        # budget even mid-critical-section (unsynchronized telemetry
+        # probes rely on this)
+        c = ServeCache(max_bytes=100)
+        c.put(("scan", 1), "x", 90)
+        c.put(("scan", 2), "y", 90)
+        assert c.resident_bytes == 90
+        assert c.high_water_bytes <= 100
+
+    def test_insert_failures_counted_under_fault(self):
+        from hyperspace_tpu.testing import faults
+
+        faults.reset()
+        try:
+            c = ServeCache(max_bytes=100)
+            faults.set_fault("cache_insert", "transient:1")
+            c.put(("scan", 1), "x", 10)  # dropped
+            assert c.get(("scan", 1)) is None
+            assert c.insert_failures == 1
+            c.put(("scan", 1), "x", 10)  # recovered
+            assert c.get(("scan", 1)) == "x"
+        finally:
+            faults.reset()
+
+    def test_evict_kind_racing_get_put(self):
+        """Two writer threads + a reader hammer the cache while the main
+        thread repeatedly evict_kind()s; accounting must stay exact, the
+        budget must hold at every unsynchronized probe, and no operation
+        may error (the lock-discipline audit's regression test)."""
+        import threading
+
+        c = ServeCache(max_bytes=5_000)
+        stop = threading.Event()
+        errors = []
+
+        def writer(tag):
+            try:
+                i = 0
+                while not stop.is_set():
+                    kind = ("scan", "joinside", "delta")[i % 3]
+                    c.put((kind, tag, i % 11), ("v", tag, i), 100 + (i % 7))
+                    c.get((kind, tag, (i + 5) % 11))
+                    c.peek((kind, tag, (i + 2) % 11))
+                    i += 1
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        def prober():
+            try:
+                while not stop.is_set():
+                    # unsynchronized reads must never observe an
+                    # over-budget ledger or a torn stats snapshot
+                    assert c.resident_bytes <= c.max_bytes
+                    st = c.stats()
+                    assert st["resident_bytes"] <= st["max_bytes"]
+                    c.bytes_by_kind()
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=writer, args=(t,)) for t in range(2)
+        ] + [threading.Thread(target=prober)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 1.0
+        evicted = 0
+        while time.monotonic() < deadline:
+            evicted += c.evict_kind("scan")
+            c.evict_kind("delta")
+        stop.set()
+        for t in threads:
+            t.join(30)
+        assert not errors, errors
+        assert evicted > 0  # the race was real
+        # exact accounting after the storm
+        with c._lock:
+            assert c._bytes == sum(nb for _v, nb in c._entries.values())
+        assert c.resident_bytes <= c.max_bytes
+        assert c.high_water_bytes <= c.max_bytes
+        c.evict_kind("scan")  # drain whatever landed after the storm
+        assert c.evict_kind("scan") == 0  # and a second pass finds nothing

@@ -1,0 +1,330 @@
+"""Concurrent serve frontend (serve/frontend.py, docs/serve-server.md).
+
+Differential doctrine: every result a concurrent serve returns must be
+bit-identical to what serial execution over the same source snapshot
+returns — across single-flight dedup, load shedding, snapshot pinning,
+and lifecycle actions (refresh/optimize/vacuum) racing the serves.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu import functions as hsf
+from hyperspace_tpu.constants import States
+from hyperspace_tpu.exceptions import ServeOverloadedError
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+from hyperspace_tpu.serve import ServeFrontend, plan_fingerprint
+from hyperspace_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture
+def s1(session_factory):
+    return session_factory(1)
+
+
+def _write_rows(path, n, seed, key_hi=400):
+    rng = np.random.default_rng(seed)
+    t = pa.table(
+        {
+            "k": pa.array(rng.integers(0, key_hi, n), pa.int64()),
+            "q": pa.array(rng.integers(1, 50, n), pa.int64()),
+        }
+    )
+    pq.write_table(t, path)
+
+
+def _atomic_append(src_dir, tmp_dir, name, n, seed):
+    """Publish a new source file atomically (write outside the listed
+    dir, then rename in) so concurrent listings never see a torn file."""
+    tmp = os.path.join(tmp_dir, name)
+    _write_rows(tmp, n, seed)
+    os.rename(tmp, os.path.join(src_dir, name))
+
+
+@pytest.fixture
+def indexed(s1, tmp_path):
+    d = tmp_path / "src"
+    d.mkdir()
+    _write_rows(str(d / "p0.parquet"), 6000, 0)
+    _write_rows(str(d / "p1.parquet"), 6000, 1)
+    s1.conf.set(C.INDEX_FILTER_RULE_USE_BUCKET_SPEC, True)
+    hs = Hyperspace(s1)
+    df = s1.read.parquet(str(d))
+    hs.create_index(df, CoveringIndexConfig("i1", ["k"], ["q"]))
+    s1.enable_hyperspace()
+    return s1, hs, df, str(d)
+
+
+def _agg(df):
+    return df.filter((df["k"] >= 50) & (df["k"] < 250)).agg(
+        hsf.count().alias("n"), hsf.sum("q").alias("sq")
+    )
+
+
+class TestAdmission:
+    def test_single_flight_dedup(self, indexed, monkeypatch):
+        s, _hs, df, _d = indexed
+        calls = []
+        gate = threading.Event()
+        from hyperspace_tpu import execution as X
+
+        real_execute = X.execute
+
+        def slow_execute(plan, session=None):
+            calls.append(plan)
+            gate.wait(10)
+            return real_execute(plan, session)
+
+        monkeypatch.setattr(X, "execute", slow_execute)
+        fe = ServeFrontend(s)
+        try:
+            q = df.filter(df["k"] == 3).select("q")
+            futs = [fe.submit(q) for _ in range(16)]
+            assert len({id(f) for f in futs}) == 1  # one shared future
+            gate.set()
+            results = [f.result(30) for f in futs]
+            assert len(calls) == 1  # ONE execution for 16 submits
+            assert all(r.equals(results[0]) for r in results)
+            assert fe.stats()["deduped"] == 15
+            assert fe.stats()["admitted"] == 1
+        finally:
+            gate.set()
+            fe.close()
+
+    def test_distinct_plans_not_deduped(self, indexed):
+        s, _hs, df, _d = indexed
+        fe = ServeFrontend(s)
+        try:
+            a = fe.submit(df.filter(df["k"] == 3).select("q"))
+            b = fe.submit(df.filter(df["k"] == 4).select("q"))
+            assert a is not b
+            a.result(30), b.result(30)
+        finally:
+            fe.close()
+
+    def test_shedding_past_queue_depth(self, indexed):
+        s, _hs, df, _d = indexed
+        s.conf.set(C.SERVE_MAX_CONCURRENCY, 1)
+        s.conf.set(C.SERVE_MAX_QUEUE_DEPTH, 1)
+        gate = threading.Event()
+        started = threading.Event()
+        from hyperspace_tpu import execution as X
+
+        real_execute = X.execute
+
+        def slow_execute(plan, session=None):
+            started.set()
+            gate.wait(10)
+            return real_execute(plan, session)
+
+        fe = ServeFrontend(s)
+        try:
+            import unittest.mock as mock
+
+            with mock.patch.object(X, "execute", slow_execute):
+                qs = [
+                    df.filter(df["k"] == i).select("q") for i in range(4)
+                ]
+                f0 = fe.submit(qs[0])
+                assert started.wait(10)  # worker busy; queue empty
+                f1 = fe.submit(qs[1])  # queued (depth 1 = full)
+                with pytest.raises(ServeOverloadedError):
+                    fe.submit(qs[2])
+                assert fe.stats()["shed"] == 1
+                gate.set()
+                assert f0.result(30) is not None
+                assert f1.result(30) is not None
+        finally:
+            gate.set()
+            fe.close()
+            s.conf.unset(C.SERVE_MAX_CONCURRENCY)
+            s.conf.unset(C.SERVE_MAX_QUEUE_DEPTH)
+
+    def test_closed_frontend_rejects(self, indexed):
+        s, _hs, df, _d = indexed
+        fe = ServeFrontend(s)
+        fe.close()
+        from hyperspace_tpu.exceptions import HyperspaceException
+
+        with pytest.raises(HyperspaceException):
+            fe.submit(df.filter(df["k"] == 1).select("q"))
+
+    def test_plan_fingerprint_sees_file_snapshots(self, indexed, tmp_path):
+        s, _hs, df, d = indexed
+        q1 = df.filter(df["k"] == 3).select("q")
+        fp1 = plan_fingerprint(q1.logical_plan)
+        assert fp1 == plan_fingerprint(q1.logical_plan)
+        _atomic_append(d, str(tmp_path), "p2.parquet", 100, 7)
+        df2 = s.read.parquet(d)
+        q2 = df2.filter(df2["k"] == 3).select("q")
+        assert fp1 != plan_fingerprint(q2.logical_plan)
+
+
+class TestConcurrentServes:
+    def test_contended_serves_bit_identical(self, indexed):
+        """8 client threads, mixed point/agg queries, serve cache on:
+        every result equals the serial baseline."""
+        s, _hs, df, _d = indexed
+        s.conf.set(C.SERVE_CACHE_ENABLED, True)
+        fe = ServeFrontend(s)
+        try:
+            keys = list(range(0, 64, 7))
+            point = {
+                k: s.execute(
+                    df.filter(df["k"] == k).select("q").logical_plan
+                )
+                for k in keys
+            }
+            agg_base = s.execute(_agg(df).logical_plan)
+            errors = []
+
+            def client(i):
+                try:
+                    for j in range(6):
+                        k = keys[(i + j) % len(keys)]
+                        out = fe.serve(df.filter(df["k"] == k).select("q"))
+                        assert out.equals(point[k])
+                        out = fe.serve(_agg(df))
+                        assert out.equals(agg_base)
+                except Exception as exc:  # propagate to the main thread
+                    errors.append(exc)
+
+            threads = [
+                threading.Thread(target=client, args=(i,)) for i in range(8)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(120)
+            assert not errors, errors
+            st = fe.stats()
+            assert st["failed"] == 0
+            assert st["completed"] >= 1
+        finally:
+            fe.close()
+            s.conf.set(C.SERVE_CACHE_ENABLED, False)
+            s.clear_serve_cache()
+
+
+class TestLifecycleWhileServing:
+    """Refresh/optimize racing continuous serves: every result matches
+    the serial result for the source snapshot that query saw — exactly
+    one pinned index version, never a mix — and the index ends ACTIVE."""
+
+    def _storm(self, s, hs, src_dir, scratch, actions, readers=3, iters=6):
+        s.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, True)
+        fe = ServeFrontend(s)
+        results = []  # (files_tuple, pydict) per serve
+        errors = []
+        stop = threading.Event()
+
+        def reader(i):
+            try:
+                for j in range(iters):
+                    df = s.read.parquet(src_dir)
+                    files = tuple(df.logical_plan.relation.files)
+                    out = fe.serve(_agg(df))
+                    results.append((files, out))
+            except Exception as exc:
+                errors.append(exc)
+
+        def writer():
+            try:
+                for step, action in enumerate(actions):
+                    action(step)
+            except Exception as exc:
+                errors.append(exc)
+            finally:
+                stop.set()
+
+        threads = [
+            threading.Thread(target=reader, args=(i,))
+            for i in range(readers)
+        ] + [threading.Thread(target=writer)]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(180)
+            assert not errors, errors
+            assert fe.stats()["failed"] == 0
+            # exactly-one-version check: per source snapshot, the serial
+            # UNINDEXED result is the unique correct answer; a serve that
+            # mixed two index versions could not reproduce it
+            s.disable_hyperspace()
+            try:
+                expected = {}
+                for files, out in results:
+                    if files not in expected:
+                        df = s.read.parquet(*files)
+                        expected[files] = s.execute(_agg(df).logical_plan)
+                    want = expected[files]
+                    assert out.equals(want), (
+                        out.to_pydict(),
+                        want.to_pydict(),
+                    )
+            finally:
+                s.enable_hyperspace()
+            entry = s.index_manager.get_index_log_entry("i1")
+            assert entry is not None and entry.state == States.ACTIVE
+        finally:
+            fe.close()
+            s.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, False)
+
+    def test_refresh_while_serving(self, indexed, tmp_path):
+        s, hs, _df, d = indexed
+        scratch = str(tmp_path / "scratch")
+        os.makedirs(scratch, exist_ok=True)
+
+        def step(i):
+            _atomic_append(d, scratch, f"a{i}.parquet", 400, 100 + i)
+            s.index_manager.clear_cache()
+            hs.refresh_index("i1", "incremental")
+
+        self._storm(s, hs, d, scratch, [step, step])
+
+    def test_optimize_while_serving(self, indexed, tmp_path):
+        s, hs, _df, d = indexed
+        scratch = str(tmp_path / "scratch")
+        os.makedirs(scratch, exist_ok=True)
+
+        def append_refresh(i):
+            _atomic_append(d, scratch, f"b{i}.parquet", 400, 200 + i)
+            s.index_manager.clear_cache()
+            hs.refresh_index("i1", "incremental")
+
+        def optimize(_i):
+            hs.optimize_index("i1", "quick")
+
+        self._storm(s, hs, d, scratch, [append_refresh, optimize])
+
+    def test_vacuum_while_serving_heals_by_repin(self, indexed, tmp_path):
+        """vacuum(ACTIVE) deletes superseded version dirs while pinned
+        queries may still hold them — the frontend's transient-I/O
+        retry re-pins onto the surviving version."""
+        s, hs, _df, d = indexed
+        scratch = str(tmp_path / "scratch")
+        os.makedirs(scratch, exist_ok=True)
+
+        def refresh_then_vacuum(i):
+            _atomic_append(d, scratch, f"c{i}.parquet", 400, 300 + i)
+            s.index_manager.clear_cache()
+            hs.refresh_index("i1", "incremental")
+            hs.vacuum_index("i1")  # ACTIVE -> vacuum outdated versions
+
+        self._storm(s, hs, d, scratch, [refresh_then_vacuum])

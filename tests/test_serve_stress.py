@@ -1,0 +1,196 @@
+"""64-client serve stress (slow; run by scripts/bench_smoke.sh / nightly).
+
+The acceptance criteria of ISSUE 8 at full scale: 64 concurrent clients
+with ``refresh`` running concurrently return results bit-identical to
+serial execution, and the ServeCache never exceeds its configured byte
+budget — probed continuously while the storm runs, not just at the end.
+Tier-1 keeps the smaller, faster versions (tests/test_serve_frontend.py,
+tests/test_serve_cache.py); these rungs exist to surface contention
+bugs that only appear past the thread-pool and LRU churn thresholds.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu import functions as hsf
+from hyperspace_tpu.constants import States
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+from hyperspace_tpu.serve import ServeFrontend
+
+pytestmark = pytest.mark.slow
+
+CLIENTS = 64
+
+
+@pytest.fixture
+def s1(session_factory):
+    return session_factory(1)
+
+
+def _write_rows(path, n, seed, key_hi=2_000):
+    rng = np.random.default_rng(seed)
+    t = pa.table(
+        {
+            "k": pa.array(rng.integers(0, key_hi, n), pa.int64()),
+            "q": pa.array(rng.integers(1, 50, n), pa.int64()),
+            "v": pa.array(rng.normal(0.0, 1.0, n)),
+        }
+    )
+    pq.write_table(t, path)
+
+
+class TestSixtyFourClients:
+    def test_64_clients_budgeted_cache_bit_identical(self, s1, tmp_path):
+        """Fixed snapshot, 64 clients, a DELIBERATELY small cache budget
+        (forces continuous LRU churn): every result equals its serial
+        baseline and the budget holds at every probe."""
+        d = tmp_path / "src"
+        d.mkdir()
+        for i in range(4):
+            _write_rows(str(d / f"p{i}.parquet"), 30_000, i)
+        s1.conf.set(C.INDEX_FILTER_RULE_USE_BUCKET_SPEC, True)
+        hs = Hyperspace(s1)
+        df = s1.read.parquet(str(d))
+        hs.create_index(df, CoveringIndexConfig("i1", ["k"], ["q", "v"]))
+        s1.enable_hyperspace()
+        keys = list(range(0, 2_000, 37))
+        baseline = {
+            k: s1.execute(
+                df.filter(df["k"] == k).select("q", "v").logical_plan
+            )
+            for k in keys
+        }
+        # small budget: big enough for a few entries, far too small for
+        # all of them — the governor must evict, not overflow
+        s1.conf.set(C.SERVE_CACHE_ENABLED, True)
+        s1.conf.set(C.SERVE_CACHE_MAX_BYTES, 2 << 20)
+        cache = s1.serve_cache
+        fe = ServeFrontend(s1)
+        errors = []
+        budget_violations = []
+        stop = threading.Event()
+
+        def prober():
+            while not stop.is_set():
+                if cache.resident_bytes > cache.max_bytes:
+                    budget_violations.append(cache.resident_bytes)
+
+        def client(i):
+            try:
+                for j in range(8):
+                    k = keys[(i * 5 + j) % len(keys)]
+                    out = fe.serve(df.filter(df["k"] == k).select("q", "v"))
+                    assert out.equals(baseline[k]), k
+            except Exception as exc:
+                errors.append(exc)
+
+        threads = [
+            threading.Thread(target=client, args=(i,))
+            for i in range(CLIENTS)
+        ] + [threading.Thread(target=prober)]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads[:-1]:
+                t.join(300)
+            stop.set()
+            threads[-1].join(30)
+            assert not errors, errors[:3]
+            assert not budget_violations, budget_violations[:5]
+            st = cache.stats()
+            assert st["high_water_bytes"] <= st["max_bytes"]
+            fes = fe.stats()
+            assert fes["failed"] == 0
+            assert fes["completed"] + fes["deduped"] >= CLIENTS * 8
+        finally:
+            stop.set()
+            fe.close()
+            s1.conf.set(C.SERVE_CACHE_ENABLED, False)
+            s1.clear_serve_cache()
+
+    def test_64_clients_with_concurrent_refresh(self, s1, tmp_path):
+        """Appends + incremental refreshes land WHILE 64 clients serve:
+        every result is bit-identical to serial execution over the
+        source snapshot that query saw, and the index ends ACTIVE."""
+        d = tmp_path / "src"
+        d.mkdir()
+        scratch = tmp_path / "scratch"
+        scratch.mkdir()
+        for i in range(2):
+            _write_rows(str(d / f"p{i}.parquet"), 20_000, i)
+        s1.conf.set(C.INDEX_FILTER_RULE_USE_BUCKET_SPEC, True)
+        s1.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, True)
+        s1.conf.set(C.SERVE_CACHE_ENABLED, True)
+        s1.conf.set(C.SERVE_CACHE_MAX_BYTES, 64 << 20)
+        hs = Hyperspace(s1)
+        df0 = s1.read.parquet(str(d))
+        hs.create_index(df0, CoveringIndexConfig("i1", ["k"], ["q", "v"]))
+        s1.enable_hyperspace()
+        fe = ServeFrontend(s1)
+        errors = []
+        results = []
+        res_lock = threading.Lock()
+
+        def agg(df):
+            return df.filter((df["k"] >= 100) & (df["k"] < 900)).agg(
+                hsf.count().alias("n"), hsf.sum("q").alias("sq")
+            )
+
+        def client(i):
+            try:
+                for j in range(4):
+                    df = s1.read.parquet(str(d))
+                    files = tuple(df.logical_plan.relation.files)
+                    out = fe.serve(agg(df))
+                    with res_lock:
+                        results.append((files, out))
+            except Exception as exc:
+                errors.append(exc)
+
+        def writer():
+            try:
+                for i in range(3):
+                    tmp = str(scratch / f"a{i}.parquet")
+                    _write_rows(tmp, 2_000, 100 + i)
+                    os.rename(tmp, str(d / f"a{i}.parquet"))
+                    s1.index_manager.clear_cache()
+                    hs.refresh_index("i1", "incremental")
+            except Exception as exc:
+                errors.append(exc)
+
+        threads = [
+            threading.Thread(target=client, args=(i,))
+            for i in range(CLIENTS)
+        ] + [threading.Thread(target=writer)]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(600)
+            assert not errors, errors[:3]
+            assert fe.stats()["failed"] == 0
+            # serial differential per distinct source snapshot
+            s1.disable_hyperspace()
+            expected = {}
+            for files, out in results:
+                if files not in expected:
+                    dfx = s1.read.parquet(*files)
+                    expected[files] = s1.execute(agg(dfx).logical_plan)
+                assert out.equals(expected[files]), files
+            entry = s1.index_manager.get_index_log_entry("i1")
+            assert entry is not None and entry.state == States.ACTIVE
+            assert (
+                s1.serve_cache.resident_bytes
+                <= s1.serve_cache.max_bytes
+            )
+        finally:
+            fe.close()
+            s1.conf.set(C.SERVE_CACHE_ENABLED, False)
+            s1.clear_serve_cache()
