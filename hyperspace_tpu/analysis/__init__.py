@@ -1,7 +1,7 @@
 """hslint — repo-native static analysis for hyperspace_tpu.
 
-Five checkers guard the three correctness-critical seams nothing else
-checks mechanically (see ``docs/static-analysis.md``):
+Seven checkers guard the correctness-critical seams nothing else checks
+mechanically (see ``docs/static-analysis.md``):
 
 * :mod:`kernel_parity` (HS1xx) — every native C++ export has a
   registered numpy twin and a differential test;
@@ -11,7 +11,14 @@ checks mechanically (see ``docs/static-analysis.md``):
   (jit/shard_map) hot-path functions;
 * :mod:`except_policy` (HS4xx) — no bare/overbroad excepts that can
   mask the native rc-code or OCC contracts;
-* :mod:`locks` (HS5xx) — no lock-order cycles, no I/O under a lock.
+* :mod:`locks` (HS5xx) — no lock-order cycles, no I/O under a lock;
+* :mod:`shared_state` (HS6xx) — every mutable global a thread pool can
+  reach is registered in ``SHARED_STATE`` (``concurrency.py``) and
+  accessed per its declared lock/policy; ``--witness`` cross-checks the
+  static lock model against a runtime witness artifact;
+* :mod:`contracts` (HS7xx) — config keys have constants defaults and
+  ``docs/CONFIG.md`` rows, fault points are matrix-tested, dead keys
+  are flagged.
 
 Run it: ``python -m hyperspace_tpu.analysis [package_dir]`` — exits
 nonzero when any unsuppressed finding remains. Suppress a finding with
@@ -27,11 +34,13 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from hyperspace_tpu.analysis import (
+    contracts,
     except_policy,
     kernel_parity,
     locks,
     log_state,
     purity,
+    shared_state,
 )
 from hyperspace_tpu.analysis.core import FINDING_FIELDS, Finding, Project
 
@@ -44,7 +53,15 @@ __all__ = [
     "run_analysis",
 ]
 
-CHECKERS = (kernel_parity, log_state, purity, except_policy, locks)
+CHECKERS = (
+    kernel_parity,
+    log_state,
+    purity,
+    except_policy,
+    locks,
+    shared_state,
+    contracts,
+)
 
 #: rule id -> one-line description; HS001 is the analyzer's own
 #: parse-failure rule.
@@ -54,11 +71,16 @@ for _mod in CHECKERS:
 
 
 def run_analysis(
-    package_dir: str, tests_dir: Optional[str] = None
+    package_dir: str,
+    tests_dir: Optional[str] = None,
+    project: Optional[Project] = None,
 ) -> List[Finding]:
     """All findings (suppressed ones included, marked) for the package at
-    ``package_dir``, sorted by (path, line, rule)."""
-    project = Project(package_dir, tests_dir=tests_dir)
+    ``package_dir``, sorted by (path, line, rule). Pass a prebuilt
+    ``project`` to share the parsed tree with other passes (the CLI's
+    ``--witness`` cross-check reuses it)."""
+    if project is None:
+        project = Project(package_dir, tests_dir=tests_dir)
     findings: List[Finding] = list(project.findings)
     for checker in CHECKERS:
         findings.extend(checker.check(project))

@@ -46,6 +46,15 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--list-rules", action="store_true", help="print the ruleset and exit"
     )
+    parser.add_argument(
+        "--witness",
+        metavar="ARTIFACT",
+        default=None,
+        help="cross-check a runtime lock-witness artifact "
+        "(testing/lock_witness.py JSON) against the static lock model: "
+        "witnessed edges/locks absent from the model are hard HS604 "
+        "errors; static edges never witnessed print as warnings",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -61,9 +70,33 @@ def main(argv=None) -> int:
             print(f"error: not a directory: {p}", file=sys.stderr)
             return 2
 
+    from hyperspace_tpu.analysis.core import Project
+
+    projects = [Project(p, tests_dir=args.tests_dir) for p in paths]
     all_findings = []
-    for p in paths:
-        all_findings.extend(run_analysis(p, tests_dir=args.tests_dir))
+    for p, project in zip(paths, projects):
+        all_findings.extend(
+            run_analysis(p, tests_dir=args.tests_dir, project=project)
+        )
+
+    if args.witness is not None:
+        from hyperspace_tpu.analysis import shared_state
+
+        try:
+            doc = shared_state.load_witness(args.witness)
+        except (OSError, ValueError) as exc:
+            print(f"error: bad witness artifact: {exc}", file=sys.stderr)
+            return 2
+        # ONE cross-check against the union of the analyzed packages'
+        # lock models: the artifact records every wrapped lock in the
+        # process, so a per-package comparison would call each package's
+        # locks "unknown" to the other
+        gaps, warnings = shared_state.witness_cross_check(
+            projects, doc, os.path.basename(args.witness)
+        )
+        all_findings.extend(gaps)
+        for w in warnings:
+            print(f"hslint: warning: {w}", file=sys.stderr)
 
     active = [f for f in all_findings if not f.suppressed]
     suppressed = [f for f in all_findings if f.suppressed]

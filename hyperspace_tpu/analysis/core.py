@@ -145,6 +145,16 @@ class Project:
         p = os.path.join(self.package_dir, "native", "hs_native.cpp")
         return p if os.path.isfile(p) else None
 
+    def doc_lines(self, name: str) -> Optional[List[str]]:
+        """Lines of ``docs/<name>`` next to the package (the contract
+        checker reads ``CONFIG.md``), or None when absent — fixture
+        trees without docs simply skip the doc-backed rules."""
+        p = os.path.join(os.path.dirname(self.package_dir), "docs", name)
+        if not os.path.isfile(p):
+            return None
+        with open(p, "r", encoding="utf-8") as f:
+            return f.read().splitlines()
+
     def test_files(self) -> List[Tuple[str, str]]:
         """(relative display path, text) for every test file."""
         if not self.tests_dir or not os.path.isdir(self.tests_dir):

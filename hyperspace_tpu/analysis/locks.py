@@ -410,13 +410,57 @@ def _lock_name(lock: LockId) -> str:
     return attr
 
 
-def check(project: Project) -> List[Finding]:
-    indexes, _ = _collect_defs(project)
-    infos = _analyze_functions(project, indexes)
-    may = _may_acquire(infos)
-    findings: List[Finding] = []
+def canonical_lock_name(lock: LockId) -> str:
+    """Stable cross-artifact name for a lock: ``<rel>::<attr>`` for a
+    module lock, ``<rel>::<Class>.<attr>`` for an instance lock. The
+    runtime lock witness (``testing/lock_witness.py``) emits the same
+    names, so witness artifacts and the static model compare directly."""
+    scope, attr = lock
+    if scope.startswith("cls:"):
+        _, rel, cls = scope.split(":", 2)
+        return f"{rel}::{cls}.{attr}"
+    return f"{scope.split(':', 1)[1]}::{attr}"
 
-    # -- edges: direct + via calls made while holding -----------------------
+
+def _model(project: Project):
+    """The full lock model of a tree — (indexes, all locks, per-function
+    infos, may-acquire sets, edges, edge anchor sites) — computed ONCE
+    per Project and memoized on it: the HS501/HS502 pass and the
+    lock-witness cross-check share one analysis."""
+    cached = getattr(project, "_locks_model_cache", None)
+    if cached is None:
+        indexes, all_locks = _collect_defs(project)
+        infos = _analyze_functions(project, indexes)
+        may = _may_acquire(infos)
+        edges, edge_sites = _edges_from(infos, may)
+        cached = (indexes, all_locks, infos, may, edges, edge_sites)
+        project._locks_model_cache = cached
+    return cached
+
+
+def build_lock_graph(
+    project: Project,
+) -> Tuple[
+    Set[LockId],
+    Dict[LockId, Set[LockId]],
+    Dict[Tuple[LockId, LockId], Tuple[str, int]],
+]:
+    """(all locks, edges, edge anchor sites) of the static lock model:
+    edge A→B when B is acquired — directly or via any callee's
+    may-acquire set — while A is held. Shared by the HS501 cycle check
+    and the lock-witness cross-check (``analysis/shared_state.py``);
+    memoized per Project."""
+    _indexes, all_locks, _infos, _may, edges, edge_sites = _model(project)
+    return all_locks, edges, edge_sites
+
+
+def _edges_from(
+    infos: Dict[FuncKey, FuncInfo], may: Dict[FuncKey, Set[LockId]]
+) -> Tuple[
+    Dict[LockId, Set[LockId]], Dict[Tuple[LockId, LockId], Tuple[str, int]]
+]:
+    """Edges: direct nested acquires + acquires via calls made while
+    holding (through the transitive may-acquire set)."""
     edges: Dict[LockId, Set[LockId]] = {}
     edge_sites: Dict[Tuple[LockId, LockId], Tuple[str, int]] = {}
     for info in infos.values():
@@ -429,6 +473,12 @@ def check(project: Project) -> List[Finding]:
                     continue
                 edges.setdefault(held, set()).add(acquired)
                 edge_sites.setdefault((held, acquired), (info.rel_path, hline))
+    return edges, edge_sites
+
+
+def check(project: Project) -> List[Finding]:
+    _indexes, _locks_, infos, _may, edges, edge_sites = _model(project)
+    findings: List[Finding] = []
 
     cycle = _find_cycle(edges)
     if cycle:

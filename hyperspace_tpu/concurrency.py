@@ -1,0 +1,181 @@
+"""SHARED_STATE — the registry of cross-thread mutable state.
+
+The KERNEL_TWINS doctrine applied to concurrency: every module-level
+(and registered class-level) mutable object that a thread-pool-submitted
+callable can reach is declared HERE, together with the lock that guards
+it and the guarding *policy* — so "is this shared state guarded?" is a
+mechanical question (``hslint`` HS6xx, ``analysis/shared_state.py``),
+not an archaeology project. The runtime lock witness
+(``testing/lock_witness.py``) wraps the locks named here during the
+stress suites and cross-checks what actually happened against this
+model (``hslint --witness``).
+
+Entry shape::
+
+    "<dotted path of the state object>": (
+        "<dotted module lock | self.<attr> | ''>",
+        "<policy>",
+        "<one-line justification — why this policy is sound>",
+    )
+
+State paths name a module-level global
+(``hyperspace_tpu.io.scan._scan_pool``) or a class instance attribute
+(``hyperspace_tpu.execution.serve_cache.ServeCache._entries``; guarded
+by an instance lock spelled ``self.<attr>``). Policies:
+
+``guarded``
+    Every access (read or write) holds the declared lock. The strictest
+    contract; HS602 flags any access outside it.
+``guarded-writes``
+    Writes hold the lock; unguarded reads are a documented benign race
+    (double-checked publication fast paths, monotonic flags, telemetry
+    probes). HS602 flags unguarded writes only.
+``rebind-only``
+    No lock: the object is never mutated in place — writers build a new
+    object and publish it with one atomic name rebind, readers grab the
+    reference once. HS602 flags any in-place mutation (``.update()``,
+    ``x[k] = v``, ``+=``); plain rebinds and reads pass.
+``frozen``
+    Populated at import time (decorator registration), read-only once
+    threads exist. HS602 flags writes from any thread-pool-reachable
+    function.
+
+Class-level state is registered opt-in (HS602 then audits every method
+of the class, ``__init__`` excluded — construction happens-before
+sharing); module-level globals are the default blast radius and HS601
+flags any unregistered one a pool-submitted callable can reach.
+
+Keep this module stdlib-only and import-cheap: the lock witness imports
+it inside test processes before any session exists.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+SHARED_STATE: Dict[str, Tuple[str, str, str]] = {
+    # -- thread pools and loaders (publish-once, read forever) ---------------
+    "hyperspace_tpu.io.scan._scan_pool": (
+        "hyperspace_tpu.io.scan._scan_pool_lock",
+        "guarded-writes",
+        "double-checked create under the lock; the published executor is "
+        "a stable reference, post-publish reads need no lock",
+    ),
+    "hyperspace_tpu.native._lib": (
+        "hyperspace_tpu.native._lock",
+        "guarded-writes",
+        "one-time CDLL load serialized by the compile lock; the unguarded "
+        "fast-path read sees None or the published library, never a torn "
+        "value",
+    ),
+    "hyperspace_tpu.native._load_failed": (
+        "hyperspace_tpu.native._lock",
+        "guarded-writes",
+        "monotonic False->True flag set under the compile lock; a stale "
+        "False read only costs one extra trip through load()",
+    ),
+    "hyperspace_tpu.native.calibrate._cached": (
+        "hyperspace_tpu.native.calibrate._probe_lock",
+        "guarded-writes",
+        "probe result published under the probe lock (invalidate() takes "
+        "it too); the lock-free fast path reads None or a complete "
+        "Thresholds tuple",
+    ),
+    "hyperspace_tpu.native.calibrate._probing": (
+        "hyperspace_tpu.native.calibrate._probe_lock",
+        "guarded-writes",
+        "re-entrancy guard for the probe's own dispatches; written only "
+        "under the probe lock, racy reads just take the defaults branch",
+    ),
+    # -- serve-plane caches --------------------------------------------------
+    "hyperspace_tpu.indexes.zonemaps._local_cache": (
+        "hyperspace_tpu.indexes.zonemaps._local_lock",
+        "guarded",
+        "bounded LRU shared by every serve thread when serve-cache mode "
+        "is off; get/put/evict/clear all run under the one lock",
+    ),
+    "hyperspace_tpu.execution.serve_cache.ServeCache._entries": (
+        "self._lock",
+        "guarded",
+        "the memory governor's entry map: every public method takes the "
+        "lock for its whole critical section (docs in serve_cache.py)",
+    ),
+    "hyperspace_tpu.execution.serve_cache.ServeCache._bytes": (
+        "self._lock",
+        "guarded-writes",
+        "byte ledger mutated only under the cache lock; resident_bytes "
+        "is a documented unsynchronized telemetry probe",
+    ),
+    "hyperspace_tpu.serve.frontend.ServeFrontend._inflight": (
+        "self._lock",
+        "guarded",
+        "single-flight dedup map: lookup+insert must be atomic or two "
+        "identical plans both execute; all accesses hold the frontend "
+        "lock",
+    ),
+    # -- telemetry (process-global, last-writer-wins by contract) ------------
+    "hyperspace_tpu.execution.join_exec.last_serve_breakdown": (
+        "hyperspace_tpu.execution.join_exec._serve_bd_lock",
+        "guarded",
+        "per-stage serve timings accumulated from pipelined worker "
+        "threads; reset and add both hold the breakdown lock",
+    ),
+    "hyperspace_tpu.indexes.covering_build.last_build_breakdown": (
+        "hyperspace_tpu.indexes.covering_build._build_bd_lock",
+        "guarded",
+        "per-stage build timings accumulated from sharded-tail workers; "
+        "reset and add both hold the breakdown lock",
+    ),
+    "hyperspace_tpu.indexes.covering_build.last_build_telemetry": (
+        "hyperspace_tpu.indexes.covering_build._build_bd_lock",
+        "guarded",
+        "shuffle-skew snapshot copied per data op under the same "
+        "breakdown lock its readers and reset take",
+    ),
+    "hyperspace_tpu.parallel.shuffle.last_shuffle_stats": (
+        "",
+        "rebind-only",
+        "diagnostic snapshot of the most recent exchange: the writer "
+        "builds a fresh dict and publishes it with one atomic rebind, "
+        "readers copy the reference they grabbed",
+    ),
+    "hyperspace_tpu.indexes.zonemaps.last_prune_stats": (
+        "",
+        "rebind-only",
+        "per-serve prune telemetry published as a whole new dict in one "
+        "rebind; concurrent serves interleave whole snapshots, never "
+        "torn ones",
+    ),
+    "hyperspace_tpu.execution.pipeline_compiler.last_fused_stats": (
+        "",
+        "rebind-only",
+        "fused-pass telemetry of the most recent execution, published as "
+        "one rebind of a freshly-built dict",
+    ),
+    # -- fault injection (testing/faults.py) ---------------------------------
+    "hyperspace_tpu.testing.faults._active": (
+        "hyperspace_tpu.testing.faults._lock",
+        "guarded-writes",
+        "arm/disarm mutate under the registry lock; the disarmed-path "
+        "read is a deliberate lock-free truthiness check (module doc)",
+    ),
+    "hyperspace_tpu.testing.faults._fired_totals": (
+        "hyperspace_tpu.testing.faults._lock",
+        "guarded",
+        "fired counters updated inside fire() and snapshotted by stats() "
+        "under the one registry lock",
+    ),
+    # -- import-time registries ----------------------------------------------
+    "hyperspace_tpu.indexes.registry._REGISTRY": (
+        "",
+        "frozen",
+        "index classes register at import time via decorator; serve/build "
+        "threads only read it",
+    ),
+    "hyperspace_tpu.indexes.sketches._SKETCH_REGISTRY": (
+        "",
+        "frozen",
+        "sketch classes register at import time via decorator; query "
+        "threads only read it",
+    ),
+}

@@ -107,6 +107,13 @@ class Config:
         )
 
     @property
+    def build_num_shards(self) -> int:
+        """Device shards for the build plane (0 = the whole session
+        mesh); a positive value caps the build mesh to the first N
+        devices."""
+        return self.get_int(C.BUILD_NUM_SHARDS, C.BUILD_NUM_SHARDS_DEFAULT)
+
+    @property
     def build_sharded_tail(self) -> bool:
         """Device-local build/serve tail on a >1-device mesh: per-shard
         sort + write and per-shard join prepare/merge, union at the

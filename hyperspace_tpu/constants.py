@@ -3,7 +3,14 @@
 Reference: ``index/IndexConstants.scala:21-170`` and
 ``actions/Constants.scala:20-34``. Keys drop the ``spark.`` prefix — this
 framework owns its own config system (see :mod:`hyperspace_tpu.config`).
+
+Contract (machine-checked by hslint HS7xx, ``analysis/contracts.py``):
+every ``hyperspace.*`` key the package reads has its ``<NAME>_DEFAULT``
+sibling here and a row in ``docs/CONFIG.md``; keys nothing reads are
+flagged as dead.
 """
+
+import os
 
 # ---------------------------------------------------------------------------
 # Index lifecycle states (actions/Constants.scala:20-34)
@@ -44,6 +51,11 @@ HYPERSPACE_APPLY_ENABLED = "hyperspace.apply.enabled"
 HYPERSPACE_APPLY_ENABLED_DEFAULT = True
 
 INDEX_SYSTEM_PATH = "hyperspace.system.path"
+# PathResolver.scala's <warehouse>/indexes, anchored at the user's home
+# (no Spark warehouse here); metadata/path_resolver.py reads through this
+INDEX_SYSTEM_PATH_DEFAULT = os.path.join(
+    os.path.expanduser("~"), "hyperspace", "indexes"
+)
 
 INDEX_NUM_BUCKETS = "hyperspace.index.num_buckets"
 INDEX_NUM_BUCKETS_DEFAULT = 200  # IndexConstants.scala:33-36 (= shuffle partitions)
@@ -151,20 +163,19 @@ DATASKIPPING_TARGET_INDEX_DATA_FILE_SIZE = (
     "hyperspace.index.dataskipping.targetIndexDataFileSize"
 )
 DATASKIPPING_TARGET_INDEX_DATA_FILE_SIZE_DEFAULT = 256 * 1024 * 1024
-DATASKIPPING_MAX_INDEX_DATA_FILE_COUNT = (
-    "hyperspace.index.dataskipping.maxIndexDataFileCount"
-)
-DATASKIPPING_MAX_INDEX_DATA_FILE_COUNT_DEFAULT = 10000
 DATASKIPPING_AUTO_PARTITION_SKETCH = (
     "hyperspace.index.dataskipping.autoPartitionSketch"
 )
 DATASKIPPING_AUTO_PARTITION_SKETCH_DEFAULT = True
 
 EVENT_LOGGER_CLASS = "hyperspace.eventLoggerClass"
+EVENT_LOGGER_CLASS_DEFAULT = ""  # empty = the no-op EventLogger
 
-# Number of device shards used for the build shuffle; default = all devices
-# in the session mesh.
+# Number of device shards used for the build plane; 0 = all devices in
+# the session mesh. A positive value caps the build mesh to the first N
+# devices (A/B scaling runs; pinning a build off busy serve chips).
 BUILD_NUM_SHARDS = "hyperspace.build.numShards"
+BUILD_NUM_SHARDS_DEFAULT = 0
 
 # ---------------------------------------------------------------------------
 # Reserved column / property names

@@ -226,7 +226,8 @@ class ServeCache:
             }
 
     def __len__(self) -> int:
-        return len(self._entries)
+        with self._lock:
+            return len(self._entries)
 
 
 class ScanCacheEntry:

@@ -18,7 +18,22 @@ class IndexerContext:
     session: object
     file_id_tracker: FileIdTracker
     index_data_path: str
+    _build_mesh: Optional[object] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
 
     @property
     def mesh(self):
-        return self.session.runtime.mesh
+        """The build-plane mesh: the session mesh, capped to the first
+        ``hyperspace.build.numShards`` devices when that conf is set
+        (0 = all). Memoized per context so one action's pipeline stages
+        all see the same mesh object."""
+        if self._build_mesh is None:
+            mesh = self.session.runtime.mesh
+            n = self.session.conf.build_num_shards
+            if 0 < n < mesh.devices.size:
+                from hyperspace_tpu.parallel.mesh import default_mesh
+
+                mesh = default_mesh(list(mesh.devices.flat)[:n])
+            self._build_mesh = mesh
+        return self._build_mesh

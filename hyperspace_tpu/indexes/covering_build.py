@@ -363,9 +363,12 @@ def _stage_add(name: str, t0: float) -> None:
 def reset_build_breakdown() -> None:
     """Called at the entry of every data op (create via
     prepare_covering_index; refresh/optimize call it directly) so the
-    breakdown never mixes two ops' stage times."""
-    last_build_breakdown.clear()
-    last_build_telemetry.clear()
+    breakdown never mixes two ops' stage times. Takes the breakdown
+    lock: a reset must never interleave with a sharded-tail worker's
+    ``_stage_add`` read-modify-write (HS602, SHARED_STATE)."""
+    with _build_bd_lock:
+        last_build_breakdown.clear()
+        last_build_telemetry.clear()
 
 
 def lazy_or_materialized(ctx, scan):

@@ -738,6 +738,8 @@ def assemble_zone_data(
 # Module-level bounded LRU for assembled zone data, so pruning works at
 # full speed with serve-server mode OFF (the default). Keyed by the file
 # fingerprint, same staleness story as the ServeCache entries.
+# SHARED_STATE-registered ("guarded": every access under _local_lock);
+# the runtime lock witness wraps _local_lock during the stress suites.
 _local_lock = threading.Lock()
 _local_cache: "OrderedDict[tuple, ZoneData]" = OrderedDict()
 _LOCAL_CACHE_ENTRIES = 64

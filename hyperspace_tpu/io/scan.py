@@ -20,6 +20,9 @@ from hyperspace_tpu.io import parquet as pio
 # Shared read-ahead pool (pipelined serve; docs/serve-pipeline.md)
 # ---------------------------------------------------------------------------
 
+# SHARED_STATE-registered (hyperspace_tpu/concurrency.py, hslint HS6xx):
+# double-checked publish under the lock, lock-free reads of the published
+# executor ("guarded-writes").
 _scan_pool = None
 _scan_pool_lock = threading.Lock()
 

@@ -13,7 +13,9 @@ from typing import List
 from hyperspace_tpu import constants as C
 
 
-DEFAULT_SYSTEM_PATH = os.path.join(os.path.expanduser("~"), "hyperspace", "indexes")
+# Kept as an alias: the default itself lives in constants.py with every
+# other key default (hslint HS701).
+DEFAULT_SYSTEM_PATH = C.INDEX_SYSTEM_PATH_DEFAULT
 
 
 class PathResolver:

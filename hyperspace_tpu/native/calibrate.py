@@ -579,6 +579,9 @@ def thresholds() -> Thresholds:
 
 
 def invalidate() -> None:
-    """Forget the in-process memo (tests; a config flip mid-process)."""
+    """Forget the in-process memo (tests; a config flip mid-process).
+    Takes the probe lock: a rebind racing a mid-probe publish must not
+    resurrect the dropped value (HS602, SHARED_STATE)."""
     global _cached
-    _cached = None
+    with _probe_lock:
+        _cached = None

@@ -221,16 +221,18 @@ def _exchange_cap(
     # hottest (shard, peer) count, so memory = skew × the balanced cost
     mean_count = float(counts.mean())
     skew = max_count / mean_count if mean_count > 0 else 1.0
-    last_shuffle_stats.clear()
-    last_shuffle_stats.update(
-        {
-            "devices": float(D),
-            "cap": float(cap),
-            "max_peer_count": float(max_count),
-            "mean_peer_count": round(mean_count, 1),
-            "skew_ratio": round(skew, 2),
-        }
-    )
+    # publish as ONE atomic rebind, never clear()+update(): a concurrent
+    # build copying the snapshot (covering_build telemetry) must see a
+    # whole dict, old or new — never the empty window between the two
+    # mutations (SHARED_STATE policy: rebind-only)
+    global last_shuffle_stats
+    last_shuffle_stats = {
+        "devices": float(D),
+        "cap": float(cap),
+        "max_peer_count": float(max_count),
+        "mean_peer_count": round(mean_count, 1),
+        "skew_ratio": round(skew, 2),
+    }
     if (
         skew > BUILD_SHUFFLE_SKEW_WARN_RATIO
         and max_count >= BUILD_SHUFFLE_SKEW_WARN_MIN_ROWS

@@ -42,7 +42,10 @@ Per-bucket parquet reads still go to the shared ``io/scan.scan_pool``
 — serve workers BLOCK on scan futures, scan workers never block on
 serve futures, so the two pools cannot deadlock (the scan pool's
 documented discipline). One frontend lock guards admission state and
-counters; nothing blocking and no I/O runs under it.
+counters; nothing blocking and no I/O runs under it. The single-flight
+map is SHARED_STATE-registered (``hyperspace_tpu/concurrency.py``,
+hslint HS6xx audits every access; the runtime lock witness wraps
+``_lock`` during the stress suites).
 """
 
 from __future__ import annotations

@@ -115,7 +115,9 @@ class EventLogging:
         self._logger_cls_name: Optional[str] = None
 
     def _resolve(self) -> EventLogger:
-        name = self._conf.get_str(C.EVENT_LOGGER_CLASS, "")
+        name = self._conf.get_str(
+            C.EVENT_LOGGER_CLASS, C.EVENT_LOGGER_CLASS_DEFAULT
+        )
         if self._logger is None or name != self._logger_cls_name:
             if name:
                 mod, _, cls = name.rpartition(".")

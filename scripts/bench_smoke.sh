@@ -18,9 +18,22 @@ if [ "$ROWS" -gt 100000 ]; then
 fi
 # The slow-marked serve stress suite (64 clients, budgeted cache,
 # concurrent refresh) is excluded from tier-1 to keep it fast; it runs
-# here so every CI pass exercises the contention rungs.
-JAX_PLATFORMS=cpu python -m pytest tests/test_serve_stress.py -q -m slow \
-    -p no:cacheprovider
+# here so every CI pass exercises the contention rungs — under the
+# runtime LOCK WITNESS (testing/lock_witness.py): every
+# SHARED_STATE-registered lock records its acquisitions and observed
+# ordering edges, and hslint --witness then cross-checks the artifact
+# against the static lock model. A witnessed edge the model lacks is a
+# hard failure (model gap).
+WITNESS="$(mktemp -t hs_lock_witness.XXXXXX.json)"
+rm -f "$WITNESS"
+HS_LOCK_WITNESS="$WITNESS" JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_serve_stress.py tests/test_serve_frontend.py \
+    -q -m 'slow or not slow' -p no:cacheprovider
+test -s "$WITNESS" || { echo "bench_smoke: lock witness artifact missing" >&2; exit 1; }
+JAX_PLATFORMS=cpu python -m hyperspace_tpu.analysis hyperspace_tpu/ \
+    --witness "$WITNESS"
+echo "bench_smoke: lock-witness cross-check ok (zero model gaps)" >&2
+rm -f "$WITNESS"
 OUT=$(JAX_PLATFORMS=cpu \
 HS_BENCH_FORCE_CPU_DEVICES=8 \
 HS_BENCH_ROWS="$ROWS" \

@@ -10,6 +10,10 @@
 #   scripts/hslint.sh --show-suppressed    # also list justified suppressions
 #   scripts/hslint.sh --format json        # machine-readable findings
 #   scripts/hslint.sh --list-rules         # the ruleset
+#   scripts/hslint.sh --witness wit.json   # + cross-check a runtime lock
+#                                          #   witness artifact (recorded by
+#                                          #   HS_LOCK_WITNESS=wit.json pytest
+#                                          #   runs) against the static model
 #
 # Rule docs: docs/static-analysis.md
 set -euo pipefail

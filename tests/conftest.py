@@ -28,6 +28,28 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _lock_witness_session():
+    """When ``HS_LOCK_WITNESS=<path>`` is set, wrap every
+    SHARED_STATE-registered lock for the whole test session and dump
+    the observed acquisition edges + per-lock counts into the artifact
+    at exit (merging across suites). ``hslint --witness <path>`` then
+    cross-checks the runtime behavior against the static lock model —
+    see scripts/bench_smoke.sh, docs/static-analysis.md."""
+    path = os.environ.get("HS_LOCK_WITNESS")
+    if not path:
+        yield
+        return
+    from hyperspace_tpu.testing import lock_witness
+
+    lock_witness.install()
+    try:
+        yield
+    finally:
+        lock_witness.dump(path)
+        lock_witness.uninstall()
+
+
 @pytest.fixture
 def tmp_index_root(tmp_path):
     """Per-test index system path (HyperspaceSuite's per-suite systemPath)."""

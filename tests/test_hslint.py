@@ -92,6 +92,44 @@ class TestPackageClean:
         ]
         assert len(traced) >= 5
 
+    def test_shared_state_checker_engages(self):
+        """The HS6xx sweep must actually see the concurrency surfaces:
+        a populated registry that resolves, thread-pool boundaries, a
+        non-trivial reachable set, and written mutable globals."""
+        from hyperspace_tpu.analysis.core import Project
+        from hyperspace_tpu.analysis import shared_state as ss
+
+        project = Project(PKG_DIR, tests_dir=TESTS_DIR)
+        entries, _line = ss.parse_registry(project)
+        assert len(entries) >= 10
+        idx = ss._PkgIndex(project)
+        for e in entries:
+            assert idx.resolve_state_path(e.path) is not None, e.path
+        checker = ss._Checker(project)
+        checker.analyze()
+        submits = {t for i in checker.infos.values() for t in i.submits}
+        assert len(submits) >= 5, submits  # scan pool, frontend, tails…
+        reachable = checker.pool_reachable()
+        assert len(reachable) >= 20
+        assert len(checker.candidate_globals()) >= 5
+
+    def test_contracts_checker_engages(self):
+        """HS7xx must see the config-key and fault-point surfaces."""
+        from hyperspace_tpu.analysis.core import Project
+        from hyperspace_tpu.analysis import contracts
+
+        project = Project(PKG_DIR, tests_dir=TESTS_DIR)
+        keys, defaults, prefixes = contracts._constants_keys(project)
+        assert len(keys) >= 20 and len(defaults) >= 20
+        assert "hyperspace.faults." in prefixes
+        used, _literals = contracts._reads(
+            project, {n for n, _l in keys.values()}
+        )
+        assert len(used) >= 20
+        points, _line, _path = contracts._fault_points(project)
+        assert set(points) >= {"parquet_read", "kernel_dispatch"}
+        assert project.doc_lines(contracts.CONFIG_DOC)
+
 
 # ---------------------------------------------------------------------------
 # Checker 1: kernel parity (HS1xx)
@@ -657,6 +695,497 @@ class TestLocks:
 
 
 # ---------------------------------------------------------------------------
+# Checker 6: shared state (HS6xx)
+# ---------------------------------------------------------------------------
+
+
+STATE_OK = """
+    import threading
+
+    _lock = threading.Lock()
+    cache = {}
+
+    def put(k, v):
+        with _lock:
+            cache[k] = v
+
+    def read_all():
+        with _lock:
+            return dict(cache)
+"""
+
+SERVE_SUBMIT = """
+    from pkg import state
+
+    def worker(item):
+        state.put(item, 1)
+
+    def run(pool, items):
+        return [pool.submit(worker, i) for i in items]
+"""
+
+REGISTRY_OK = '''
+    SHARED_STATE = {
+        "pkg.state.cache": (
+            "pkg.state._lock",
+            "guarded",
+            "all access under the lock",
+        ),
+    }
+'''
+
+
+class TestSharedState:
+    def test_registered_guarded_is_clean(self, tmp_path):
+        files = {
+            "concurrency.py": REGISTRY_OK,
+            "state.py": STATE_OK,
+            "serve.py": SERVE_SUBMIT,
+        }
+        assert _lint(tmp_path, files) == []
+
+    def test_unregistered_pool_reachable_global(self, tmp_path):
+        # seeded violation: a written module global reached from a
+        # pool-submitted closure with no SHARED_STATE entry
+        files = {
+            "concurrency.py": REGISTRY_OK,
+            "state.py": STATE_OK,
+            "serve.py": SERVE_SUBMIT
+            + """
+    stats = {}
+
+    def telemetry(item):
+        stats[item] = 1
+
+    def run2(pool, items):
+        return [pool.submit(telemetry, i) for i in items]
+""",
+        }
+        findings = [f for f in _lint(tmp_path, files) if f.rule == "HS601"]
+        assert findings and "stats" in findings[0].message
+
+    def test_nested_closure_is_reached(self, tmp_path):
+        # the submitted callable is a closure DEFINED INSIDE the
+        # submitting function — the resolver must still reach it
+        files = {
+            "concurrency.py": REGISTRY_OK,
+            "state.py": STATE_OK,
+            "serve.py": """
+    totals = {}
+
+    def run(pool, items):
+        def one(i):
+            totals[i] = totals.get(i, 0) + 1
+        return [pool.submit(one, i) for i in items]
+""",
+        }
+        findings = [f for f in _lint(tmp_path, files) if f.rule == "HS601"]
+        assert findings and "totals" in findings[0].message
+
+    def test_never_written_global_is_config_not_state(self, tmp_path):
+        # a module dict nothing writes (a KERNEL_TWINS-style registry
+        # literal) is configuration, not shared state
+        files = {
+            "concurrency.py": REGISTRY_OK,
+            "state.py": STATE_OK,
+            "serve.py": SERVE_SUBMIT
+            + """
+    TABLE = {"a": 1}
+
+    def lookup(item):
+        return TABLE.get(item)
+
+    def run3(pool, items):
+        return [pool.submit(lookup, i) for i in items]
+""",
+        }
+        assert _lint(tmp_path, files) == []
+
+    def test_guarded_policy_violation(self, tmp_path):
+        # seeded violation: a lock-free read of "guarded" state
+        files = {
+            "concurrency.py": REGISTRY_OK,
+            "state.py": STATE_OK
+            + """
+    def peek(k):
+        return cache.get(k)
+""",
+            "serve.py": SERVE_SUBMIT,
+        }
+        findings = [f for f in _lint(tmp_path, files) if f.rule == "HS602"]
+        assert findings and "peek" in findings[0].message
+
+    def test_guarded_writes_allows_racy_reads(self, tmp_path):
+        registry = REGISTRY_OK.replace('"guarded"', '"guarded-writes"')
+        files = {
+            "concurrency.py": registry,
+            "state.py": STATE_OK
+            + """
+    def peek(k):
+        return cache.get(k)
+""",
+            "serve.py": SERVE_SUBMIT,
+        }
+        assert _lint(tmp_path, files) == []
+
+    def test_rebind_only_flags_in_place_mutation(self, tmp_path):
+        files = {
+            "concurrency.py": '''
+    SHARED_STATE = {
+        "pkg.state.last_stats": (
+            "",
+            "rebind-only",
+            "published as one atomic rebind",
+        ),
+    }
+''',
+            "state.py": """
+    last_stats = {}
+
+    def publish_ok(d):
+        global last_stats
+        last_stats = dict(d)
+
+    def publish_torn(d):
+        last_stats.clear()
+        last_stats.update(d)
+""",
+        }
+        findings = [f for f in _lint(tmp_path, files) if f.rule == "HS602"]
+        assert len(findings) == 2  # clear + update; the rebind is clean
+
+    def test_stale_registry_entries(self, tmp_path):
+        # three distinct staleness shapes: unknown state path, unknown
+        # lock, unknown policy — one HS603 each
+        files = {
+            "concurrency.py": '''
+    SHARED_STATE = {
+        "pkg.state.cache": (
+            "pkg.state._lock",
+            "guarded",
+            "all access under the lock",
+        ),
+        "pkg.state.gone": (
+            "pkg.state._lock",
+            "guarded",
+            "stale",
+        ),
+        "pkg.state.cache2": (
+            "pkg.state._missing_lock",
+            "guarded",
+            "bad lock",
+        ),
+        "pkg.state.cache3": (
+            "pkg.state._lock",
+            "bogus-policy",
+            "bad policy",
+        ),
+    }
+''',
+            "state.py": STATE_OK + "\n    cache2 = {}\n    cache3 = {}\n",
+        }
+        rules = [f.rule for f in _lint(tmp_path, files)]
+        assert rules.count("HS603") == 3
+
+    def test_missing_justification(self, tmp_path):
+        files = {
+            "concurrency.py": REGISTRY_OK.replace(
+                '"all access under the lock"', '""'
+            ),
+            "state.py": STATE_OK,
+        }
+        findings = [f for f in _lint(tmp_path, files) if f.rule == "HS603"]
+        assert findings and "justification" in findings[0].message
+
+    def test_suppression(self, tmp_path):
+        files = {
+            "concurrency.py": REGISTRY_OK,
+            "state.py": STATE_OK,
+            "serve.py": SERVE_SUBMIT
+            + """
+    stats = {}
+
+    def telemetry(item):
+        # single-writer bench counter by contract
+        stats[item] = 1  # hslint: disable=HS601
+
+    def run2(pool, items):
+        return [pool.submit(telemetry, i) for i in items]
+""",
+        }
+        assert _lint(tmp_path, files) == []
+
+    def test_instance_attr_policy(self, tmp_path):
+        # registered class attribute: __init__ is exempt, unlocked
+        # method access is flagged
+        files = {
+            "concurrency.py": '''
+    SHARED_STATE = {
+        "pkg.cachemod.Cache._entries": (
+            "self._lock",
+            "guarded",
+            "map guarded by the instance lock",
+        ),
+    }
+''',
+            "cachemod.py": """
+    import threading
+
+    class Cache:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._entries = {}
+
+        def get(self, k):
+            with self._lock:
+                return self._entries.get(k)
+
+        def size_unlocked(self):
+            return len(self._entries)
+""",
+        }
+        findings = [f for f in _lint(tmp_path, files) if f.rule == "HS602"]
+        assert len(findings) == 1 and "size_unlocked" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# Checker 7: contracts (HS7xx)
+# ---------------------------------------------------------------------------
+
+
+CONTRACT_CONSTANTS = """
+    FOO = "hyperspace.foo.enabled"
+    FOO_DEFAULT = True
+    BAR = "hyperspace.bar.limit"
+"""
+
+CONTRACT_CONFIG = """
+    from pkg import constants as C
+
+    def foo(conf):
+        return conf.get_bool(C.FOO, C.FOO_DEFAULT)
+
+    def bar(conf):
+        return conf.get_int(C.BAR, 3)
+"""
+
+CONTRACT_DOC = """\
+# Config
+
+| Key | Default | Meaning |
+|---|---|---|
+| `hyperspace.foo.enabled` | `true` | the foo switch |
+| `hyperspace.bar.limit` | `3` | the bar bound |
+"""
+
+
+def _write_doc(tmp_path, text=CONTRACT_DOC):
+    d = tmp_path / "docs"
+    d.mkdir(exist_ok=True)
+    (d / "CONFIG.md").write_text(text)
+
+
+class TestContracts:
+    def test_missing_default(self, tmp_path):
+        _write_doc(tmp_path)
+        files = {
+            "constants.py": CONTRACT_CONSTANTS,
+            "config.py": CONTRACT_CONFIG,
+        }
+        findings = [f for f in _lint(tmp_path, files) if f.rule == "HS701"]
+        assert len(findings) == 1 and "BAR" in findings[0].message
+
+    def test_literal_key_read(self, tmp_path):
+        _write_doc(tmp_path)
+        files = {
+            "constants.py": CONTRACT_CONSTANTS + "    BAR_DEFAULT = 3\n",
+            "config.py": CONTRACT_CONFIG
+            + """
+    def sneaky(conf):
+        return conf.get("hyperspace.sneaky.key")
+""",
+        }
+        findings = [f for f in _lint(tmp_path, files) if f.rule == "HS701"]
+        assert len(findings) == 1 and "sneaky" in findings[0].message
+
+    def test_undocumented_key(self, tmp_path):
+        _write_doc(
+            tmp_path,
+            CONTRACT_DOC.replace(
+                "| `hyperspace.bar.limit` | `3` | the bar bound |\n", ""
+            ),
+        )
+        files = {
+            "constants.py": CONTRACT_CONSTANTS + "    BAR_DEFAULT = 3\n",
+            "config.py": CONTRACT_CONFIG,
+        }
+        findings = [f for f in _lint(tmp_path, files) if f.rule == "HS702"]
+        assert len(findings) == 1 and "hyperspace.bar.limit" in findings[0].message
+
+    def test_dead_documented_key(self, tmp_path):
+        _write_doc(
+            tmp_path,
+            CONTRACT_DOC + "| `hyperspace.ghost.key` | `x` | gone |\n",
+        )
+        files = {
+            "constants.py": CONTRACT_CONSTANTS + "    BAR_DEFAULT = 3\n",
+            "config.py": CONTRACT_CONFIG,
+        }
+        findings = [f for f in _lint(tmp_path, files) if f.rule == "HS704"]
+        assert len(findings) == 1 and "ghost" in findings[0].message
+
+    def test_dead_declared_key(self, tmp_path):
+        _write_doc(tmp_path)
+        files = {
+            "constants.py": CONTRACT_CONSTANTS
+            + '    BAR_DEFAULT = 3\n    BAZ = "hyperspace.baz.unused"\n',
+            "config.py": CONTRACT_CONFIG,
+        }
+        findings = [f for f in _lint(tmp_path, files) if f.rule == "HS704"]
+        assert len(findings) == 1 and "BAZ" in findings[0].message
+
+    def test_fault_matrix_hole(self, tmp_path):
+        _write_doc(tmp_path)
+        files = {
+            "constants.py": CONTRACT_CONSTANTS + "    BAR_DEFAULT = 3\n",
+            "config.py": CONTRACT_CONFIG,
+            "testing/faults.py": 'POINTS = ("a_point", "b_point")\n',
+        }
+        tests = {
+            "test_faults.py": "def test_matrix():\n    assert 'a_point'\n"
+        }
+        findings = [
+            f for f in _lint(tmp_path, files, tests=tests) if f.rule == "HS703"
+        ]
+        assert len(findings) == 1 and "b_point" in findings[0].message
+
+    def test_clean_and_prefix_family(self, tmp_path):
+        _write_doc(
+            tmp_path,
+            CONTRACT_DOC
+            + "| `hyperspace.faults.<point>` | unset | injection |\n",
+        )
+        files = {
+            "constants.py": CONTRACT_CONSTANTS
+            + '    BAR_DEFAULT = 3\n    FAULTS_PREFIX = "hyperspace.faults."\n',
+            "config.py": CONTRACT_CONFIG
+            + """
+    def faults(conf):
+        return conf.prefixed(C.FAULTS_PREFIX)
+""",
+        }
+        assert _lint(tmp_path, files) == []
+
+    def test_suppression_in_constants(self, tmp_path):
+        _write_doc(tmp_path)
+        files = {
+            "constants.py": CONTRACT_CONSTANTS.replace(
+                'BAR = "hyperspace.bar.limit"',
+                '    # required key: no default by design\n'
+                '    BAR = "hyperspace.bar.limit"  # hslint: disable=HS701',
+            ),
+            "config.py": CONTRACT_CONFIG,
+        }
+        assert _lint(tmp_path, files) == []
+
+
+# ---------------------------------------------------------------------------
+# The lock witness: record → cross-check round trip
+# ---------------------------------------------------------------------------
+
+
+class TestLockWitness:
+    @pytest.fixture
+    def witness(self):
+        # the recorder is process-global: these tests reset and
+        # uninstall it, which would gut a session-level recording
+        if os.environ.get("HS_LOCK_WITNESS"):
+            pytest.skip("HS_LOCK_WITNESS session recording is active")
+        from hyperspace_tpu.testing import lock_witness
+
+        lock_witness.reset()
+        lock_witness.install()
+        try:
+            yield lock_witness
+        finally:
+            lock_witness.uninstall()
+            lock_witness.reset()
+
+    def test_round_trip_clean(self, tmp_path, witness):
+        # drive real guarded paths: module lock + instance lock
+        from hyperspace_tpu.execution.serve_cache import ServeCache
+        from hyperspace_tpu.indexes import zonemaps
+
+        cache = ServeCache(1 << 20)
+        cache.put(("scan", "fp"), "v", 8)
+        assert cache.get(("scan", "fp")) == "v"
+        zonemaps.invalidate_local_cache()
+        path = str(tmp_path / "witness.json")
+        doc = witness.dump(path)
+        assert doc["locks"]["execution/serve_cache.py::ServeCache._lock"] >= 2
+        assert doc["locks"]["indexes/zonemaps.py::_local_lock"] >= 1
+        from hyperspace_tpu.analysis import shared_state as ss
+        from hyperspace_tpu.analysis.core import Project
+
+        project = Project(PKG_DIR, tests_dir=TESTS_DIR)
+        gaps, _warnings = ss.witness_cross_check(
+            [project], ss.load_witness(path), "witness.json"
+        )
+        assert gaps == []
+
+    def test_model_gap_detected(self, tmp_path, witness):
+        # manufacture a nested acquisition the static graph does NOT
+        # contain: the cross-check must call it a hard model gap
+        from hyperspace_tpu.execution import join_exec
+        from hyperspace_tpu.indexes import zonemaps
+
+        with zonemaps._local_lock:
+            with join_exec._serve_bd_lock:
+                pass
+        path = str(tmp_path / "witness.json")
+        witness.dump(path)
+        from hyperspace_tpu.analysis import shared_state as ss
+        from hyperspace_tpu.analysis.core import Project
+
+        project = Project(PKG_DIR, tests_dir=TESTS_DIR)
+        gaps, _warnings = ss.witness_cross_check(
+            [project], ss.load_witness(path), "witness.json"
+        )
+        assert len(gaps) == 1 and gaps[0].rule == "HS604"
+        assert "_local_lock" in gaps[0].message
+        assert "_serve_bd_lock" in gaps[0].message
+
+    def test_artifacts_merge(self, tmp_path, witness):
+        from hyperspace_tpu.indexes import zonemaps
+
+        path = str(tmp_path / "witness.json")
+        zonemaps.invalidate_local_cache()
+        first = witness.dump(path)
+        witness.reset()
+        zonemaps.invalidate_local_cache()
+        second = witness.dump(path)
+        key = "indexes/zonemaps.py::_local_lock"
+        assert second["locks"][key] == first["locks"][key] + 1
+
+    def test_malformed_artifact_rejected(self, tmp_path):
+        # every malformed shape must raise ValueError (the CLI's exit-2
+        # contract), never crash downstream with a raw traceback
+        from hyperspace_tpu.analysis import shared_state as ss
+
+        bad_docs = [
+            '{"not": "a witness"}',
+            '{"version": 1, "locks": {}, "edges": [["one_element"]]}',
+            '{"version": 1, "locks": ["a"], "edges": []}',
+            '{"version": 1, "locks": {"a": "n"}, "edges": []}',
+        ]
+        for i, text in enumerate(bad_docs):
+            p = tmp_path / f"bad{i}.json"
+            p.write_text(text)
+            with pytest.raises(ValueError):
+                ss.load_witness(str(p))
+
+
+# ---------------------------------------------------------------------------
 # Golden: ruleset + finding schema stability
 # ---------------------------------------------------------------------------
 
@@ -680,6 +1209,14 @@ class TestGolden:
         "HS402",
         "HS501",
         "HS502",
+        "HS601",
+        "HS602",
+        "HS603",
+        "HS604",
+        "HS701",
+        "HS702",
+        "HS703",
+        "HS704",
     ]
 
     def test_ruleset_is_stable(self):
@@ -751,3 +1288,32 @@ class TestCli:
         assert proc.returncode == 0
         for rule in TestGolden.EXPECTED_RULES:
             assert rule in proc.stdout
+
+    def test_witness_clean_exits_zero(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        _write_tree(pkg, {"m.py": "def f():\n    return 1\n"})
+        wit = tmp_path / "wit.json"
+        wit.write_text('{"version": 1, "locks": {}, "edges": []}')
+        proc = self._run(str(pkg), "--witness", str(wit))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_witness_model_gap_exits_one(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        _write_tree(pkg, {"m.py": "def f():\n    return 1\n"})
+        wit = tmp_path / "wit.json"
+        wit.write_text(
+            '{"version": 1, "locks": {"ghost.py::_x": 1}, "edges": []}'
+        )
+        proc = self._run(str(pkg), "--witness", str(wit))
+        assert proc.returncode == 1
+        assert "HS604" in proc.stdout
+
+    def test_witness_malformed_exits_two(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        _write_tree(pkg, {"m.py": "def f():\n    return 1\n"})
+        wit = tmp_path / "wit.json"
+        wit.write_text("{not json")
+        proc = self._run(str(pkg), "--witness", str(wit))
+        assert proc.returncode == 2
+        proc = self._run(str(pkg), "--witness", str(tmp_path / "absent.json"))
+        assert proc.returncode == 2

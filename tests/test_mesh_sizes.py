@@ -94,3 +94,26 @@ def test_join_cross_mesh(session_factory, dataset, tmp_path, build_devs, serve_d
     got = q(f2, d2f).collect()
     assert sorted_table(got).equals(sorted_table(base))
     assert got.num_rows > 0
+
+
+def test_build_num_shards_caps_build_mesh(session_factory):
+    """`hyperspace.build.numShards` caps the build-plane mesh to the
+    first N devices (0 = the whole session mesh) — the IndexerContext
+    is where every build stage reads its mesh from."""
+    from hyperspace_tpu.indexes.context import IndexerContext
+    from hyperspace_tpu.metadata.entry import FileIdTracker
+
+    session = session_factory(8)
+    ctx = IndexerContext(session, FileIdTracker(), "unused")
+    assert ctx.mesh.devices.size == 8
+
+    session.conf.set(C.BUILD_NUM_SHARDS, 2)
+    capped = IndexerContext(session, FileIdTracker(), "unused")
+    assert capped.mesh.devices.size == 2
+    # memoized per context: both reads see one mesh object
+    assert capped.mesh is capped.mesh
+    # 0 and >mesh-size leave the session mesh untouched
+    session.conf.set(C.BUILD_NUM_SHARDS, 0)
+    assert IndexerContext(session, FileIdTracker(), "unused").mesh.devices.size == 8
+    session.conf.set(C.BUILD_NUM_SHARDS, 64)
+    assert IndexerContext(session, FileIdTracker(), "unused").mesh.devices.size == 8
