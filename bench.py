@@ -482,6 +482,32 @@ def main() -> None:
         )
         assert fault_stats["failed"] == 0
 
+        # --- chaos rung (testing/chaos.py, docs/recovery.md): a seeded
+        # lifecycle schedule crashed at each (step x point) cell in
+        # turn, recovered, retried, and differentially served — the
+        # bench-level witness that a crashed writer never strands an
+        # index, never changes an answer, and never leaks an orphan
+        # (bench_smoke.sh gates on the three zeros below)
+        from hyperspace_tpu.testing import chaos as _chaos
+
+        chaos_summary = _chaos.run_crash_matrix(
+            os.path.join(tmp, "chaos"),
+            seed=11,
+            n_steps=10,
+            max_cells=int(os.environ.get("HS_BENCH_CHAOS_CELLS", 8)),
+        )
+        assert chaos_summary["crashes_fired"] >= 1, chaos_summary
+        assert chaos_summary["stranded_after_recovery"] == 0, chaos_summary
+        assert chaos_summary["orphans_after_gc"] == 0, chaos_summary
+        assert chaos_summary["serve_mismatches"] == 0, chaos_summary
+        log(
+            f"chaos: {chaos_summary['cells']} cells, "
+            f"{chaos_summary['crashes_fired']} crashes fired, "
+            f"{chaos_summary['rolled_back']} rollbacks, "
+            f"{chaos_summary['serves_verified']} serves verified, "
+            f"0 stranded / 0 orphans / 0 mismatches"
+        )
+
         session.conf.set(C.SERVE_CACHE_ENABLED, False)
         session.clear_serve_cache()  # later stages measure uncached paths;
         # keeping 200+MB resident would only add allocator/page pressure
@@ -993,6 +1019,7 @@ def main() -> None:
                         join_raw["p50"] / join_cached["p50"], 3
                     ),
                     "serve_concurrency": serve_concurrency,
+                    "chaos": chaos_summary,
                     "fault_injection": {
                         "fired": fault_fired,
                         "frontend_retries": fault_stats["retries"],

@@ -4,14 +4,29 @@ Reference: ``actions/Action.scala:34-108``. The id arithmetic (`:35-36`):
 ``baseId`` = latest existing log id (0 if none); begin writes ``baseId+1``
 (transient), end writes ``baseId+2`` (final) and recreates the
 ``latestStable`` pointer. A concurrent writer loses the ``write_log``
-create-if-absent race and aborts. ``NoChangesException`` from ``validate``
-makes the whole action a graceful no-op (refresh/optimize with nothing to
-do).
+create-if-absent race — and, since the recovery plane (PR 10), retries
+from a fresh snapshot with backoff instead of aborting on the first
+collision. ``NoChangesException`` from ``validate`` makes the whole
+action a graceful no-op (refresh/optimize with nothing to do).
+
+Crash safety (``metadata/recovery.py``, docs/recovery.md): ``run()``
+first repairs any dead writer's leavings at the log tip
+(``ensure_recovered`` — rollback of lease-expired transient entries,
+latestStable healing), re-snapshots ``base_id`` (the ``__init__``-time
+read is advisory only; a queued action must see the tip as of *run*,
+not construction), stamps a writer lease into the begin entry, and
+heartbeats that lease while ``op()`` runs so a slow writer is never
+mistaken for a dead one. The named crash points
+(``testing/faults.py``: after_begin_log / after_data_write /
+after_end_log here; mid_data_write / mid_vacuum_delete at the data
+seams) let the test matrix kill the writer between any two protocol
+steps and assert recovery.
 """
 
 from __future__ import annotations
 
 import abc
+import time
 from typing import Optional
 
 from hyperspace_tpu.exceptions import (
@@ -22,6 +37,7 @@ from hyperspace_tpu.exceptions import (
 from hyperspace_tpu.metadata.entry import IndexLogEntry
 from hyperspace_tpu.metadata.log_manager import IndexLogManager
 from hyperspace_tpu.telemetry import HyperspaceEvent
+from hyperspace_tpu.testing import faults
 
 
 class Action(abc.ABC):
@@ -54,32 +70,84 @@ class Action(abc.ABC):
     def event(self, success: bool, message: str = "") -> Optional[HyperspaceEvent]:
         return None
 
-    # -- driver (Action.run:84-105) -----------------------------------------
+    def _resnapshot(self) -> None:
+        """Re-read every log-derived member off the CURRENT tip.
+
+        ``__init__`` snapshots ``base_id`` (and, in subclasses, the
+        previous entry / version dir / tracker), but an action may run
+        long after construction — and the OCC retry loop re-enters here
+        after a collision. Subclasses that cache more than ``base_id``
+        extend this; nothing outside ``run()`` may rely on the
+        construction-time snapshot."""
+        self.base_id = self.log_manager.get_latest_id() or 0
+
+    # -- driver (Action.run:84-105 + recovery/retry) ------------------------
     def run(self) -> None:
-        try:
-            self.validate()
-        except NoChangesException:
-            self._log_event(True, "No-op action")
-            return
-        begin = self.begin_log_entry().with_state(self.transient_state)
-        begin.id = self.base_id + 1
-        if not self.log_manager.write_log(self.base_id + 1, begin):
-            raise ConcurrentWriteException(
-                f"Another operation is in progress (log id "
-                f"{self.base_id + 1} already exists)"
-            )
+        from hyperspace_tpu.metadata import recovery
+
+        conf = self.session.conf
+        recovery_on = conf.recovery_enabled
+        attempts = conf.recovery_retry_max_attempts if recovery_on else 1
+        backoff = conf.recovery_retry_backoff_ms / 1000.0
+        lease_ms = conf.recovery_lease_ms
+        owner = recovery.new_owner_id()
+        begin = None
+        for attempt in range(1, attempts + 1):
+            if attempt > 1 and backoff > 0:
+                time.sleep(backoff * (1 << (attempt - 2)))
+            # fix a dead writer's leavings BEFORE snapshotting: a
+            # stranded transient tip rolls back (appending an entry), a
+            # stale latestStable pointer heals — then the snapshot below
+            # sees the repaired log
+            if recovery_on:
+                recovery.ensure_recovered(self.log_manager, lease_ms)
+            self._resnapshot()
+            try:
+                self.validate()
+            except NoChangesException:
+                self._log_event(True, "No-op action")
+                return
+            begin = self.begin_log_entry().with_state(self.transient_state)
+            if recovery_on:
+                recovery.stamp_lease(begin, owner, lease_ms)
+            begin.id = self.base_id + 1
+            if self.log_manager.write_log(self.base_id + 1, begin):
+                break
+            if attempt >= attempts:
+                raise ConcurrentWriteException(
+                    f"Another operation is in progress (log id "
+                    f"{self.base_id + 1} already exists after {attempts} "
+                    f"attempts)"
+                )
+        faults.crash("after_begin_log", type(self).__name__)
+        heartbeat = None
+        if recovery_on:
+            heartbeat = recovery.LeaseHeartbeat(
+                self.log_manager, self.base_id + 1, begin, owner, lease_ms
+            ).start()
         try:
             self.op()
+            faults.crash("after_data_write", type(self).__name__)
             final = self.log_entry().with_state(self.final_state)
             final.id = self.base_id + 2
             if not self.log_manager.write_log(self.base_id + 2, final):
+                # the end id exists already: a cancel()/recovery rolled
+                # our transient entry back under us — the data work must
+                # not be published over their write
                 raise ConcurrentWriteException(
                     f"Concurrent write at log id {self.base_id + 2}"
                 )
+            faults.crash("after_end_log", type(self).__name__)
             self.log_manager.create_latest_stable_log(self.base_id + 2)
         except Exception as e:
             self._log_event(False, str(e))
             raise
+        finally:
+            # stopped on every in-process exit, incl. SimulatedCrash —
+            # mirroring reality: when the process dies the heartbeat
+            # thread dies with it, and the lease starts aging
+            if heartbeat is not None:
+                heartbeat.stop()
         self._log_event(True)
 
     def _log_event(self, success: bool, message: str = "") -> None:

@@ -6,13 +6,25 @@ a transient state, then appends a copy of the last stable entry so every
 operation sees the pre-failure state again; ``Hyperspace.scala:139-151``).
 Does not follow the begin/op/end protocol — it writes exactly one log
 entry — so it overrides ``run``.
+
+Since the recovery plane (PR 10) the actual rollback write lives in
+``metadata/recovery.rollback`` and is shared with automatic
+stranded-entry recovery. Cancel is the MANUAL override on top of it: it
+does not consult the writer lease (the operator said the writer is
+dead; a live writer racing a cancel loses its end-commit OCC write at
+``base_id + 2`` — exactly the id the rollback takes — and aborts), while
+automatic recovery only rolls back expired leases.
 """
 
 from __future__ import annotations
 
 from hyperspace_tpu.actions.base import Action
 from hyperspace_tpu.constants import States
-from hyperspace_tpu.exceptions import ConcurrentWriteException, HyperspaceException
+from hyperspace_tpu.exceptions import (
+    ConcurrentWriteException,
+    HyperspaceException,
+    LogCorruptedError,
+)
 from hyperspace_tpu.metadata.entry import IndexLogEntry
 from hyperspace_tpu.telemetry import CancelActionEvent
 
@@ -26,7 +38,13 @@ class CancelAction(Action):
         self.index_name = index_name
 
     def validate(self) -> None:
-        latest = self.log_manager.get_latest_log()
+        try:
+            latest = self.log_manager.get_latest_log()
+        except LogCorruptedError:
+            # a torn tip is a crashed writer's leavings — exactly what
+            # cancel exists to clear; rollback() knows how to roll past
+            # (or clear) it
+            return
         if latest is None:
             raise HyperspaceException(f"Index not found: {self.index_name!r}")
         if latest.state in States.STABLE_STATES:
@@ -42,20 +60,20 @@ class CancelAction(Action):
         raise NotImplementedError
 
     def run(self) -> None:
+        from hyperspace_tpu.metadata import recovery
+
+        self._resnapshot()
         self.validate()
-        stable = self.log_manager.get_latest_stable_log()
-        if stable is None:
-            # Nothing stable ever existed (failed create): mark DOESNOTEXIST
-            latest = self.log_manager.get_latest_log()
-            entry = latest.with_state(States.DOESNOTEXIST)
-        else:
-            entry = stable.copy()
-        entry.id = self.base_id + 1
-        if not self.log_manager.write_log(self.base_id + 1, entry):
+        _tip, we_wrote = recovery.rollback(self.log_manager, self.base_id)
+        if not we_wrote:
+            # OUR rollback write lost the OCC race. The survivor may even
+            # be the live writer's own end-commit — a stable tip, but the
+            # OPPOSITE of what the operator asked for — so a cancel that
+            # didn't perform the cancellation must say so, like any OCC
+            # conflict
             raise ConcurrentWriteException(
                 f"Concurrent write at log id {self.base_id + 1}"
             )
-        self.log_manager.create_latest_stable_log(self.base_id + 1)
         self._log_event(True)
 
     def event(self, success, message=""):

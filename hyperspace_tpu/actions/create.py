@@ -38,11 +38,15 @@ class CreateAction(Action):
         self.df = df
         self.index_config = index_config
         self.data_manager: IndexDataManager = data_manager
+        self._sources = session.source_manager
+        self._resnapshot()
+
+    def _resnapshot(self) -> None:
+        super()._resnapshot()
         self.tracker = FileIdTracker()
         version = (self.data_manager.get_latest_version_id() or 0) + 1
         self.index_data_path = self.data_manager.get_path(version)
         self._index = None
-        self._sources = session.source_manager
 
     # -- validation (CreateAction.scala:50-81) ------------------------------
     def validate(self) -> None:

@@ -23,11 +23,16 @@ class _StateFlipAction(Action):
     def __init__(self, session, index_name: str, log_manager):
         super().__init__(session, log_manager)
         self.index_name = index_name
+        self._previous: IndexLogEntry | None = None
+        self._resnapshot()
+
+    def _resnapshot(self) -> None:
+        super()._resnapshot()
         # Validate against the LATEST entry, stable or not: a dangling
         # transient state (failed action) blocks every operation until
-        # cancel() (reference Action validations read the latest entry;
-        # SURVEY §5 failure-detection notes).
-        self._previous: IndexLogEntry | None = log_manager.get_latest_log()
+        # cancel()/recovery (reference Action validations read the
+        # latest entry; SURVEY §5 failure-detection notes).
+        self._previous = self.log_manager.get_latest_log()
 
     def validate(self) -> None:
         if self._previous is None:

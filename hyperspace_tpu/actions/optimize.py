@@ -33,11 +33,17 @@ class OptimizeAction(Action):
         self.index_name = index_name
         self.data_manager = data_manager
         self.mode = mode
+        self._resnapshot()
+
+    def _resnapshot(self) -> None:
+        super()._resnapshot()
         # latest (not latest-stable): a dangling transient state blocks
-        # optimize until cancel()
-        self._previous: Optional[IndexLogEntry] = log_manager.get_latest_log()
-        version = (data_manager.get_latest_version_id() or 0) + 1
-        self.index_data_path = data_manager.get_path(version)
+        # optimize until cancel()/recovery
+        self._previous: Optional[IndexLogEntry] = (
+            self.log_manager.get_latest_log()
+        )
+        version = (self.data_manager.get_latest_version_id() or 0) + 1
+        self.index_data_path = self.data_manager.get_path(version)
         self.tracker = (
             self._previous.file_id_tracker() if self._previous else None
         )

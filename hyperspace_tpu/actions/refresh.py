@@ -44,11 +44,20 @@ class RefreshActionBase(Action):
         super().__init__(session, log_manager)
         self.index_name = index_name
         self.data_manager = data_manager
-        # latest (not latest-stable): a dangling transient state blocks
-        # refresh until cancel()
-        self._previous: Optional[IndexLogEntry] = log_manager.get_latest_log()
-        version = (data_manager.get_latest_version_id() or 0) + 1
-        self.index_data_path = data_manager.get_path(version)
+        self._resnapshot()
+
+    def _resnapshot(self) -> None:
+        """Derive previous entry, target version dir, tracker and the
+        source-file snapshot off the current log tip — at construction
+        AND again at run() (OCC retry / queued-action safety). Previous
+        = latest (not latest-stable): a dangling transient state blocks
+        refresh until cancel()/recovery."""
+        super()._resnapshot()
+        self._previous: Optional[IndexLogEntry] = (
+            self.log_manager.get_latest_log()
+        )
+        version = (self.data_manager.get_latest_version_id() or 0) + 1
+        self.index_data_path = self.data_manager.get_path(version)
         self.tracker: FileIdTracker = (
             self._previous.file_id_tracker() if self._previous else FileIdTracker()
         )

@@ -20,6 +20,7 @@ from hyperspace_tpu.constants import (
 )
 from hyperspace_tpu.metadata.entry import IndexLogEntry
 from hyperspace_tpu.telemetry import VacuumActionEvent, VacuumOutdatedActionEvent
+from hyperspace_tpu.testing import faults
 from hyperspace_tpu.utils import files as file_utils
 
 
@@ -36,6 +37,10 @@ class VacuumAction(_StateFlipAction):
         for name in sorted(os.listdir(index_path)):
             if name == HYPERSPACE_LOG_DIR:
                 continue
+            # crash seam: a vacuum that dies between deletes leaves a
+            # half-emptied index dir under a VACUUMING entry — recovery
+            # rolls the log back to DELETED and a re-vacuum finishes
+            faults.crash("mid_vacuum_delete", name)
             file_utils.delete(os.path.join(index_path, name))
 
     def log_entry(self) -> IndexLogEntry:
@@ -71,11 +76,13 @@ class VacuumOutdatedAction(_StateFlipAction):
         }
         for version in self.data_manager.get_all_versions():
             if version not in live_versions:
+                faults.crash("mid_vacuum_delete", f"v__={version}")
                 self.data_manager.delete(version)
                 continue
             root = self.data_manager.get_path(version)
             for path, _s, _m in file_utils.list_leaf_files(root):
                 if path not in live_files:
+                    faults.crash("mid_vacuum_delete", path)
                     file_utils.delete(path)
 
     @staticmethod

@@ -15,9 +15,10 @@ mechanical:
 * HS702 — a key the package reads has no row in ``docs/CONFIG.md``:
   every operator-visible knob is documented or it does not ship.
 * HS703 — a fault point armed in ``testing/faults.py`` (``POINTS``)
-  never appears in ``tests/test_faults.py``: the point × {transient,
-  persistent} matrix is the tested contract, an unexercised point is an
-  untested failure mode.
+  never appears in ``tests/test_faults.py``, or a crash point
+  (``CRASH_POINTS``) never appears in ``tests/test_crash_recovery.py``:
+  the point × mode (and crash point × action) matrices are the tested
+  contract, an unexercised point is an untested failure mode.
 * HS704 — a dead key: a ``hyperspace.*`` token documented in
   ``docs/CONFIG.md`` that no constants entry backs (or that nothing
   reads), or a key constant in ``constants.py`` that nothing reads —
@@ -47,6 +48,7 @@ RULES = {
 CONSTANTS_FILE = "constants.py"
 FAULTS_FILE = "testing/faults.py"
 FAULT_TESTS = "test_faults.py"
+CRASH_TESTS = "test_crash_recovery.py"
 CONFIG_DOC = "CONFIG.md"
 
 _GETTERS = frozenset(
@@ -126,8 +128,12 @@ def _doc_tokens(lines: List[str]) -> List[Tuple[str, int]]:
     return out
 
 
-def _fault_points(project: Project) -> Tuple[List[str], int, Optional[str]]:
-    """(POINTS entries, line, display path) from testing/faults.py."""
+def _fault_points(
+    project: Project, var_name: str = "POINTS"
+) -> Tuple[List[str], int, Optional[str]]:
+    """(``var_name`` tuple entries, line, display path) from
+    testing/faults.py — POINTS for the injection registry, CRASH_POINTS
+    for the crash registry."""
     sf = project.file(FAULTS_FILE)
     if sf is None or sf.tree is None:
         return [], 0, None
@@ -135,7 +141,7 @@ def _fault_points(project: Project) -> Tuple[List[str], int, Optional[str]]:
         if not isinstance(node, ast.Assign):
             continue
         targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
-        if "POINTS" not in targets:
+        if var_name not in targets:
             continue
         if isinstance(node.value, (ast.Tuple, ast.List)):
             pts = [const_str(e) for e in node.value.elts]
@@ -232,12 +238,17 @@ def check(project: Project) -> List[Finding]:
                 )
             )
 
-    # -- HS703: the fault matrix covers every point --------------------------
-    points, pts_line, faults_path = _fault_points(project)
-    if points:
+    # -- HS703: the fault/crash matrices cover every point -------------------
+    for var_name, tests_file, what in (
+        ("POINTS", FAULT_TESTS, "point × mode"),
+        ("CRASH_POINTS", CRASH_TESTS, "crash point × action"),
+    ):
+        points, pts_line, faults_path = _fault_points(project, var_name)
+        if not points:
+            continue
         matrix = None
         for rel, text in project.test_files():
-            if rel.endswith(FAULT_TESTS):
+            if rel.endswith(tests_file):
                 matrix = text
                 break
         if matrix is not None:
@@ -249,8 +260,8 @@ def check(project: Project) -> List[Finding]:
                             faults_path or FAULTS_FILE,
                             pts_line,
                             f"fault point {p!r} is armed in "
-                            "testing/faults.py but never appears in "
-                            f"tests/{FAULT_TESTS} — the point × mode "
+                            f"testing/faults.py but never appears in "
+                            f"tests/{tests_file} — the {what} "
                             "matrix has a hole",
                         )
                     )

@@ -22,7 +22,11 @@ Legal edges, derived statically from the States class:
   ROLLBACK[transient_state]: validating against any other state makes
   the begin edge illegal (HS204);
 * a ROLLBACK key no action uses as its transient state is dead machine
-  surface (HS205) — either a missing action or a stale state.
+  surface (HS205) — either a missing action or a stale state;
+* every ROLLBACK edge must LAND on a stable state (HS206): the rollback
+  edges are exactly what crash recovery (``metadata/recovery.py``) and
+  ``cancel()`` traverse, and an edge into another transient state would
+  make "recover" mean "strand differently".
 """
 
 from __future__ import annotations
@@ -38,6 +42,7 @@ RULES = {
     "HS203": "unknown state name referenced in a transition site",
     "HS204": "required_state does not match the transient state's ROLLBACK source",
     "HS205": "transient state defined in ROLLBACK but used by no action",
+    "HS206": "ROLLBACK edge lands on a non-stable state (recovery would strand)",
 }
 
 
@@ -195,6 +200,18 @@ def check(project: Project) -> List[Finding]:
                     1,
                     f"ROLLBACK defines transient state {t} but no Action "
                     "uses it (unreachable state)",
+                )
+            )
+        if machine.rollback[t] not in machine.stable:
+            findings.append(
+                Finding(
+                    "HS206",
+                    constants_path,
+                    1,
+                    f"ROLLBACK edge {t} -> {machine.rollback[t]} lands on "
+                    "a non-stable state — crash recovery and cancel() "
+                    "walk these edges and must terminate on a stable "
+                    "state",
                 )
             )
     return findings

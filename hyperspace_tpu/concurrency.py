@@ -152,7 +152,28 @@ SHARED_STATE: Dict[str, Tuple[str, str, str]] = {
         "fused-pass telemetry of the most recent execution, published as "
         "one rebind of a freshly-built dict",
     ),
+    # -- recovery plane (metadata/recovery.py) -------------------------------
+    "hyperspace_tpu.metadata.recovery._active_pins": (
+        "hyperspace_tpu.metadata.recovery._pins_lock",
+        "guarded",
+        "serve snapshot pin registry consulted by orphan GC; register/"
+        "release/union all hold the pins lock (the frozensets handed out "
+        "are immutable)",
+    ),
+    "hyperspace_tpu.metadata.recovery._pin_seq": (
+        "hyperspace_tpu.metadata.recovery._pins_lock",
+        "guarded",
+        "monotonic pin-token counter incremented only under the pins "
+        "lock",
+    ),
     # -- fault injection (testing/faults.py) ---------------------------------
+    "hyperspace_tpu.testing.faults._crash_active": (
+        "hyperspace_tpu.testing.faults._lock",
+        "guarded-writes",
+        "crash-point arm/disarm mutate under the registry lock; the "
+        "disarmed-path read is the same deliberate lock-free truthiness "
+        "check the fault registry documents",
+    ),
     "hyperspace_tpu.testing.faults._active": (
         "hyperspace_tpu.testing.faults._lock",
         "guarded-writes",

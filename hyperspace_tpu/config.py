@@ -245,6 +245,47 @@ class Config:
         )
 
     @property
+    def recovery_enabled(self) -> bool:
+        """Crash-safe lifecycle recovery (metadata/recovery.py): writer
+        leases, stranded-entry rollback, stale-pointer healing, and the
+        OCC retry loop in Action.run."""
+        return self.get_bool(C.RECOVERY_ENABLED, C.RECOVERY_ENABLED_DEFAULT)
+
+    @property
+    def recovery_lease_ms(self) -> int:
+        return max(
+            1, self.get_int(C.RECOVERY_LEASE_MS, C.RECOVERY_LEASE_MS_DEFAULT)
+        )
+
+    @property
+    def recovery_orphan_grace_ms(self) -> int:
+        return max(
+            0,
+            self.get_int(
+                C.RECOVERY_ORPHAN_GRACE_MS, C.RECOVERY_ORPHAN_GRACE_MS_DEFAULT
+            ),
+        )
+
+    @property
+    def recovery_retry_max_attempts(self) -> int:
+        return max(
+            1,
+            self.get_int(
+                C.RECOVERY_RETRY_MAX_ATTEMPTS,
+                C.RECOVERY_RETRY_MAX_ATTEMPTS_DEFAULT,
+            ),
+        )
+
+    @property
+    def recovery_retry_backoff_ms(self) -> int:
+        return max(
+            0,
+            self.get_int(
+                C.RECOVERY_RETRY_BACKOFF_MS, C.RECOVERY_RETRY_BACKOFF_MS_DEFAULT
+            ),
+        )
+
+    @property
     def serve_pipeline_enabled(self) -> bool:
         return self.get_bool(
             C.SERVE_PIPELINE_ENABLED, C.SERVE_PIPELINE_ENABLED_DEFAULT

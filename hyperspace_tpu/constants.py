@@ -310,3 +310,51 @@ SERVE_RETRY_BACKOFF_MS_DEFAULT = 10
 # retry/degrade behavior under armed faults is the tested contract
 # (docs/serve-server.md fault matrix).
 FAULTS_KEY_PREFIX = "hyperspace.faults."
+
+# Crash injection (same module): ``hyperspace.faults.crash.<point>``
+# with a spec "raise[;at=N][;match=substr]" (in-process SimulatedCrash)
+# or "exit[...]" (os._exit mid-protocol — true torn state). Points:
+# after_begin_log, mid_data_write, after_data_write, after_end_log,
+# mid_vacuum_delete. The crash × action recovery matrix is the tested
+# contract (docs/recovery.md, tests/test_crash_recovery.py).
+CRASH_KEY_PREFIX = "hyperspace.faults.crash."
+
+# -- crash-safe lifecycle recovery (metadata/recovery.py) --------------------
+# Master switch for the recovery plane: writer leases stamped into
+# transient log entries, stranded-entry rollback at action start /
+# session attach, stale latestStable healing, and the OCC retry loop in
+# Action.run. Off = the pre-recovery behavior (a crashed writer strands
+# the index until a manual cancel()).
+RECOVERY_ENABLED = "hyperspace.recovery.enabled"
+RECOVERY_ENABLED_DEFAULT = True
+
+# Writer lease duration. A live action's heartbeat re-stamps its
+# transient entry every leaseMs/3; an entry whose lease expired belongs
+# to a DEAD writer (crash) and may be rolled back — this is what makes a
+# slow writer distinguishable from a dead one. Entries written before
+# the lease era (no lease properties) fall back to entry.timestamp +
+# leaseMs.
+RECOVERY_LEASE_MS = "hyperspace.recovery.leaseMs"
+RECOVERY_LEASE_MS_DEFAULT = 60_000
+
+# Orphan GC quarantine TTL: index data files referenced by no stable log
+# entry are first MOVED into <index>/_hyperspace_quarantine/<stamp>/ and
+# only deleted once the stamp is older than this grace period — so a
+# serve that pinned its snapshot before the files went unreferenced
+# finishes from the quarantine-free window (in-process pins are excluded
+# from quarantine outright; the TTL covers other processes).
+RECOVERY_ORPHAN_GRACE_MS = "hyperspace.recovery.orphanGraceMs"
+RECOVERY_ORPHAN_GRACE_MS_DEFAULT = 10 * 60_000
+
+# Lifecycle retry: an action losing the write_log OCC race re-snapshots
+# the log tip and retries with exponential backoff (the PR 8 serve-retry
+# shape at the write boundary) instead of surfacing
+# ConcurrentWriteException to the user on the first collision.
+RECOVERY_RETRY_MAX_ATTEMPTS = "hyperspace.recovery.retry.maxAttempts"
+RECOVERY_RETRY_MAX_ATTEMPTS_DEFAULT = 3
+RECOVERY_RETRY_BACKOFF_MS = "hyperspace.recovery.retry.backoffMs"
+RECOVERY_RETRY_BACKOFF_MS_DEFAULT = 10
+
+# Quarantine directory name (underscore-prefixed: invisible to data
+# scans, like HYPERSPACE_LOG_DIR).
+HYPERSPACE_QUARANTINE_DIR = "_hyperspace_quarantine"

@@ -27,6 +27,22 @@ class ConcurrentWriteException(HyperspaceException):
     """
 
 
+class LogCorruptedError(HyperspaceException):
+    """An operation-log entry exists but does not parse (truncated or
+    torn JSON — e.g. a crash on a filesystem without atomic
+    publish-by-link).
+
+    Typed so the recovery plane (``metadata/recovery.py``) can treat a
+    torn entry as STRANDED — recoverable by rollback, like any other
+    dead writer's leavings — instead of the raw decode traceback
+    aborting every read of the index."""
+
+    def __init__(self, path: str, reason: str):
+        super().__init__(f"corrupted log entry {path}: {reason}")
+        self.path = path
+        self.reason = reason
+
+
 class ServeOverloadedError(HyperspaceException):
     """Admission control shed this query: the serve frontend's queue of
     admitted-but-not-running queries reached

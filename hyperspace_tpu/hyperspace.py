@@ -56,6 +56,15 @@ class Hyperspace:
         with self._maintenance():
             self._manager.cancel(index_name)
 
+    def recover(self, index_name: str, gc: bool = True) -> dict:
+        """Repair a crashed writer's leavings on one index: roll back a
+        stranded transient log entry (lease-expired or torn), heal a
+        stale latestStable pointer, and garbage-collect orphan data
+        files (quarantine + grace TTL; ``metadata/recovery.py``,
+        docs/recovery.md). Idempotent; returns the repair report."""
+        with self._maintenance():
+            return self._manager.recover(index_name, gc=gc)
+
     def _maintenance(self):
         from hyperspace_tpu.rules.apply import hyperspace_rule_disabled
 

@@ -462,6 +462,11 @@ def write_bucket_file(
     (``indexes/covering_build._write_bucketed_pipelined``) and of
     :func:`write_bucket_files` below."""
     path = os.path.join(out_dir, bucket_file_name(file_idx_offset + bucket, bucket))
+    # crash seam (testing/faults.py "mid_data_write", with at=N selecting
+    # the Nth file): a build that dies here leaves a partially-populated
+    # version dir under a transient log entry — the orphans recovery GC
+    # must quarantine
+    faults.crash("mid_data_write", path)
     if (
         len(idx)
         and len(idx) == int(idx[-1]) - int(idx[0]) + 1
@@ -517,6 +522,7 @@ def write_table(path: str, table: pa.Table) -> None:
     # other index payload written through here) get row-group min/max
     # statistics narrow enough for the serve-side zone-map pruning
     # (indexes/zonemaps.py) to drop most groups under a range predicate.
+    faults.crash("mid_data_write", path)
     os.makedirs(os.path.dirname(path), exist_ok=True)
     pq.write_table(
         table,

@@ -25,6 +25,55 @@ class IndexCollectionManager:
     def __init__(self, session):
         self.session = session
         self.path_resolver = PathResolver(session.conf)
+        # Session attach = the natural stranded-entry sweep point: a
+        # writer that died in a PREVIOUS process left transient entries
+        # whose leases have long expired — repair them before this
+        # session reads or writes anything. Best-effort by contract
+        # (attach must never fail on someone else's wreckage); indexes a
+        # LIVE writer holds (unexpired lease) are left alone.
+        if session.conf.recovery_enabled:
+            try:
+                self.recover_all(gc=False)
+            except OSError:
+                pass
+
+    # -- recovery (metadata/recovery.py, docs/recovery.md) -------------------
+    def recover(self, index_name: str, gc: bool = True) -> dict:
+        """Repair one index: roll back a stranded transient entry, heal
+        a stale latestStable pointer, and (``gc=True``) quarantine-then-
+        delete orphan data files. Returns the combined report."""
+        from hyperspace_tpu.metadata import recovery
+
+        log_mgr, _ = self._managers(index_name)
+        conf = self.session.conf
+        report = recovery.ensure_recovered(log_mgr, conf.recovery_lease_ms)
+        if gc:
+            report["gc"] = recovery.gc_orphans(
+                log_mgr.index_path,
+                conf.recovery_orphan_grace_ms,
+                lease_ms=conf.recovery_lease_ms,
+            )
+        return report
+
+    def recover_all(self, gc: bool = False) -> List[dict]:
+        """Stranded-entry sweep over every index under the system path."""
+        from hyperspace_tpu import factories
+        from hyperspace_tpu.metadata import recovery
+
+        conf = self.session.conf
+        out = []
+        for path in self.path_resolver.all_index_paths():
+            log_mgr = factories.create_log_manager(path)
+            report = recovery.ensure_recovered(log_mgr, conf.recovery_lease_ms)
+            if gc:
+                report["gc"] = recovery.gc_orphans(
+                    path,
+                    conf.recovery_orphan_grace_ms,
+                    lease_ms=conf.recovery_lease_ms,
+                )
+            report["index_path"] = path
+            out.append(report)
+        return out
 
     # -- wiring -------------------------------------------------------------
     def _managers(self, index_name: str):
@@ -186,3 +235,20 @@ class CachingIndexCollectionManager(IndexCollectionManager):
 
     def cancel(self, index_name: str) -> None:
         self._mutate(super().cancel, index_name)
+
+    def recover(self, index_name: str, gc: bool = True) -> dict:
+        self.clear_cache()
+        try:
+            return super().recover(index_name, gc)
+        finally:
+            self.clear_cache()
+
+    def recover_all(self, gc: bool = False) -> List[dict]:
+        # clear_cache only ASSIGNS, so the virtual call from the base
+        # __init__ (attach sweep, before this subclass's __init__ body
+        # runs) is safe
+        self.clear_cache()
+        try:
+            return super().recover_all(gc)
+        finally:
+            self.clear_cache()

@@ -21,10 +21,22 @@ from hyperspace_tpu.constants import (
     LATEST_STABLE_LOG_NAME,
     States,
 )
+from hyperspace_tpu.exceptions import LogCorruptedError
 from hyperspace_tpu.metadata.entry import IndexLogEntry
 from hyperspace_tpu.testing import faults
 from hyperspace_tpu.utils import files as file_utils
 from hyperspace_tpu.utils import json_utils
+
+
+def _parse_entry(path: str) -> IndexLogEntry:
+    """Parse one on-disk log entry; typed LogCorruptedError on torn or
+    unparseable JSON (a crash artifact, not a caller bug — the recovery
+    plane treats it as a stranded entry)."""
+    text = file_utils.read_text(path)
+    try:
+        return IndexLogEntry.from_dict(json_utils.from_json(text))
+    except (ValueError, KeyError, TypeError) as exc:
+        raise LogCorruptedError(path, f"{type(exc).__name__}: {exc}") from exc
 
 
 class IndexLogManager:
@@ -52,7 +64,7 @@ class IndexLogManager:
         faults.check("log_read", p)
         if not os.path.isfile(p):
             return None
-        return IndexLogEntry.from_dict(json_utils.from_json(file_utils.read_text(p)))
+        return _parse_entry(p)
 
     def get_latest_id(self) -> Optional[int]:
         """Highest numeric log file present (getLatestId)."""
@@ -71,16 +83,25 @@ class IndexLogManager:
         p = self._latest_stable_path
         faults.check("log_read", p)
         if os.path.isfile(p):
-            entry = IndexLogEntry.from_dict(
-                json_utils.from_json(file_utils.read_text(p))
-            )
-            if entry.state in States.STABLE_STATES:
+            try:
+                entry = _parse_entry(p)
+            except LogCorruptedError:
+                # torn pointer (crash mid-publish on a no-atomic-rename
+                # mount): fall through to the backward scan — the
+                # numbered entries are the source of truth
+                entry = None
+            if entry is not None and entry.state in States.STABLE_STATES:
                 return entry
         latest = self.get_latest_id()
         if latest is None:
             return None
         for log_id in range(latest, -1, -1):
-            entry = self.get_log(log_id)
+            try:
+                entry = self.get_log(log_id)
+            except LogCorruptedError:
+                # a torn entry is a stranded WRITE, not a reason the
+                # index has no stable history: keep scanning past it
+                continue
             if entry is not None and entry.state in States.STABLE_STATES:
                 return entry
         return None
@@ -93,10 +114,28 @@ class IndexLogManager:
             return []
         out = []
         for log_id in range(latest, -1, -1):
-            entry = self.get_log(log_id)
+            try:
+                entry = self.get_log(log_id)
+            except LogCorruptedError:
+                continue
             if entry is not None and entry.state in states:
                 out.append(log_id)
         return out
+
+    def get_latest_stable_pointer_id(self) -> Optional[int]:
+        """The id the latestStable POINTER file records — without the
+        backward-scan fallback. None when the pointer is missing, torn,
+        or names a non-stable entry. The recovery plane compares this
+        against the latest stable entry to heal a crash that landed
+        between end-log commit and pointer publish."""
+        p = self._latest_stable_path
+        if not os.path.isfile(p):
+            return None
+        try:
+            entry = _parse_entry(p)
+        except LogCorruptedError:
+            return None
+        return entry.id if entry.state in States.STABLE_STATES else None
 
     # -- writes -------------------------------------------------------------
     def write_log(self, log_id: int, entry: IndexLogEntry) -> bool:
@@ -113,6 +152,18 @@ class IndexLogManager:
         if ok:
             entry.id = log_id
         return ok
+
+    def overwrite_log(self, log_id: int, entry: IndexLogEntry) -> None:
+        """Atomically REPLACE log file ``log_id`` — outside the OCC
+        create-if-absent protocol on purpose. The single legitimate use
+        is a live writer's lease heartbeat re-stamping its own TRANSIENT
+        entry (``metadata/recovery.py``); final entries are immutable
+        and only ever created through :meth:`write_log`."""
+        payload = entry.to_dict()
+        payload["id"] = log_id
+        file_utils.atomic_overwrite(
+            self._path_for(log_id), json_utils.to_json(payload, indent=2)
+        )
 
     def create_latest_stable_log(self, log_id: int) -> bool:
         """Copy entry ``log_id`` onto the latestStable pointer

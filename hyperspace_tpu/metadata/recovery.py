@@ -1,0 +1,504 @@
+"""Crash-safe lifecycle recovery: leases, rollback, orphan GC.
+
+The operation log's OCC protocol (``actions/base.py``,
+``metadata/log_manager.py``) is correct for writers that FINISH — a
+writer that dies mid-``op()`` strands a transient entry
+(CREATING/REFRESHING/…) and the data files it half-wrote, forever.
+Exoshuffle (PAPERS.md) argues fault tolerance belongs in the data-plane
+framework itself; this module is that plane for the index lifecycle:
+
+* **Writer lease / heartbeat.** ``Action.run`` stamps an owner id and a
+  lease expiry into the transient begin entry and re-stamps it every
+  ``leaseMs/3`` while the op runs (:class:`LeaseHeartbeat`, via
+  ``IndexLogManager.overwrite_log`` — the one sanctioned mutation of a
+  log entry, legal only for the owner of a TRANSIENT entry). A slow
+  writer keeps its lease fresh; a dead writer's lease expires. That
+  expiry is the dead/slow discriminator every other piece keys on.
+
+* **Stranded-entry detection + rollback.** :func:`ensure_recovered`
+  runs at action start (``Action.run``) and session attach
+  (``manager.IndexCollectionManager``). A latest entry that is
+  transient with an expired lease — or torn
+  (:class:`~hyperspace_tpu.exceptions.LogCorruptedError`) — is rolled
+  back along the ``constants.States.ROLLBACK`` edge by appending a copy
+  of the last stable entry at the next id (exactly ``cancel()``'s
+  write, shared here). The write is the standard OCC create-if-absent
+  with fsync-before-publish, so two concurrent recoverers cannot
+  double-roll: one wins the id, the other observes the new entry. A
+  crash BETWEEN end-log commit and latestStable publish needs no
+  rollback, only healing: the pointer is re-published from the newest
+  stable entry.
+
+* **Orphan data GC.** :func:`gc_orphans` quarantines index data files
+  referenced by no stable log entry into
+  ``<index>/_hyperspace_quarantine/<stamp>/`` and deletes quarantine
+  stamps older than ``hyperspace.recovery.orphanGraceMs``. Files pinned
+  by an in-process serve snapshot (``serve/frontend.py`` registers its
+  pins here) are never quarantined, so a live query cannot lose its
+  files mid-flight; the grace TTL covers readers in other processes.
+
+Everything is idempotent and OCC-safe by construction: rollback loses
+races gracefully, GC re-run finds nothing, pointer healing rewrites the
+same bytes.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import time
+import uuid
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from hyperspace_tpu.constants import (
+    HYPERSPACE_LOG_DIR,
+    HYPERSPACE_QUARANTINE_DIR,
+    RECOVERY_LEASE_MS_DEFAULT,
+    RECOVERY_ORPHAN_GRACE_MS_DEFAULT,
+    States,
+)
+from hyperspace_tpu.exceptions import LogCorruptedError
+from hyperspace_tpu.metadata.entry import IndexLogEntry
+from hyperspace_tpu.metadata.log_manager import IndexLogManager
+from hyperspace_tpu.utils import files as file_utils
+from hyperspace_tpu.utils import paths as path_utils
+
+# Lease bookkeeping lives in the entry's free-form ``properties`` dict —
+# round-trips through the existing JSON schema untouched, and pre-lease
+# entries simply lack the keys (timestamp fallback below).
+LEASE_OWNER_PROP = "recovery.leaseOwner"
+LEASE_EXPIRES_PROP = "recovery.leaseExpiresAtMs"
+
+
+def now_ms() -> int:
+    return int(time.time() * 1000)
+
+
+def new_owner_id() -> str:
+    return uuid.uuid4().hex
+
+
+# ---------------------------------------------------------------------------
+# Leases
+# ---------------------------------------------------------------------------
+
+
+def stamp_lease(
+    entry: IndexLogEntry, owner: str, lease_ms: int, now: Optional[int] = None
+) -> None:
+    """Stamp (or renew) the writer lease on a transient entry."""
+    now = now_ms() if now is None else now
+    entry.properties[LEASE_OWNER_PROP] = owner
+    entry.properties[LEASE_EXPIRES_PROP] = str(now + lease_ms)
+
+
+def clear_lease(entry: IndexLogEntry) -> None:
+    entry.properties.pop(LEASE_OWNER_PROP, None)
+    entry.properties.pop(LEASE_EXPIRES_PROP, None)
+
+
+def lease_expires_at(entry: IndexLogEntry, lease_ms: int) -> int:
+    """When this entry's writer must be presumed dead (ms epoch).
+
+    Entries from before the lease era (or written with recovery off)
+    have no lease properties; their write timestamp plus one lease
+    period is the conservative stand-in."""
+    raw = entry.properties.get(LEASE_EXPIRES_PROP)
+    if raw is not None:
+        try:
+            return int(raw)
+        except (TypeError, ValueError):
+            pass
+    return int(entry.timestamp) + lease_ms
+
+
+def is_stranded(
+    entry: Optional[IndexLogEntry],
+    lease_ms: int = RECOVERY_LEASE_MS_DEFAULT,
+    now: Optional[int] = None,
+) -> bool:
+    """True when ``entry`` is a dead writer's leavings: a transient
+    state whose lease has expired. A torn entry (``entry is None`` from
+    a caught LogCorruptedError) is always stranded — a live writer's
+    entry parses, its publish is fsynced before the name exists."""
+    if entry is None:
+        return True
+    if entry.state in States.STABLE_STATES:
+        return False
+    now = now_ms() if now is None else now
+    return lease_expires_at(entry, lease_ms) <= now
+
+
+class LeaseHeartbeat:
+    """Renews the writer lease on a transient entry every ``lease/3``
+    until stopped. Owned by ``Action.run``: started right after the
+    begin entry wins its OCC write, stopped in the commit/abort path.
+    An ``os._exit`` crash (or SIGKILL) never stops it — the thread dies
+    with the process and the lease expires, which is the signal."""
+
+    def __init__(
+        self,
+        log_manager: IndexLogManager,
+        log_id: int,
+        entry: IndexLogEntry,
+        owner: str,
+        lease_ms: int,
+    ):
+        self._log_manager = log_manager
+        self._log_id = log_id
+        self._entry = entry
+        self._owner = owner
+        self._lease_ms = lease_ms
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"hs-lease-{log_id}", daemon=True
+        )
+
+    def start(self) -> "LeaseHeartbeat":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        interval = max(self._lease_ms / 3000.0, 0.005)
+        while not self._stop.wait(interval):
+            stamp_lease(self._entry, self._owner, self._lease_ms)
+            try:
+                self._log_manager.overwrite_log(self._log_id, self._entry)
+            except OSError:
+                # best-effort: a failed renewal only ages the lease; the
+                # next tick retries, and a recovery triggered by a
+                # genuinely unreachable log dir is the correct outcome
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# Rollback + pointer healing
+# ---------------------------------------------------------------------------
+
+
+def _latest_stable_by_scan(
+    log_manager: IndexLogManager, below_id: int
+) -> Optional[IndexLogEntry]:
+    """Newest parseable stable entry with id < ``below_id`` — the
+    rollback source. Scans the numbered entries, never the pointer (the
+    pointer may itself be stale or torn after a crash)."""
+    for log_id in range(below_id - 1, -1, -1):
+        try:
+            entry = log_manager.get_log(log_id)
+        except LogCorruptedError:
+            continue
+        if entry is not None and entry.state in States.STABLE_STATES:
+            return entry
+    return None
+
+
+def rollback(
+    log_manager: IndexLogManager, latest_id: Optional[int] = None
+) -> Tuple[Optional[IndexLogEntry], bool]:
+    """Roll the log back from a transient/torn latest entry to its
+    stable predecessor along the ``States.ROLLBACK`` edge.
+
+    Appends a copy of the last stable entry (or the transient entry
+    restamped with its rollback state when nothing stable ever existed
+    — the failed-create case) at ``latest_id + 1`` and republishes
+    latestStable. OCC-safe: the append is create-if-absent, so of two
+    concurrent recoverers exactly one writes; the loser re-reads and
+    returns whatever won. Shared by ``actions/cancel.py`` (the manual
+    override, which does not check leases) and
+    :func:`ensure_recovered` (which does).
+
+    Returns ``(tip_entry, we_wrote)``: the entry now at the log tip
+    (None when the log ended up empty) and whether THIS call performed
+    the recovery. ``we_wrote=False`` means a competitor's write — a
+    concurrent recoverer's rollback, or the not-dead-after-all writer's
+    own end-commit — won the id; the caller decides whether the
+    survivor satisfies it (auto-recovery: yes, any stable tip does;
+    cancel: no, a commit is the opposite of a cancel)."""
+    if latest_id is None:
+        latest_id = log_manager.get_latest_id()
+    if latest_id is None:
+        return None, False
+    try:
+        latest = log_manager.get_log(latest_id)
+    except LogCorruptedError:
+        latest = None
+    if latest is not None and latest.state in States.STABLE_STATES:
+        return latest, False  # nothing to roll back (someone already did)
+    stable = _latest_stable_by_scan(log_manager, latest_id)
+    if stable is not None:
+        entry = stable.copy()
+    elif latest is not None:
+        # no stable history (a crashed first create): the ROLLBACK edge
+        # names the target — DOESNOTEXIST for CREATING
+        target = States.ROLLBACK.get(latest.state, States.DOESNOTEXIST)
+        entry = latest.with_state(target)
+    else:
+        # single torn entry and no stable history: the index never
+        # reached a publishable state — clear the wreckage so the name
+        # is reusable (get_latest_id -> None == DOESNOTEXIST)
+        file_utils.delete(log_manager._path_for(latest_id))
+        log_manager.delete_latest_stable_log()
+        return None, True
+    clear_lease(entry)
+    if not log_manager.write_log(latest_id + 1, entry):
+        # another recoverer (or the not-dead-after-all writer's commit)
+        # won the id: their write is the truth now
+        try:
+            return log_manager.get_log(log_manager.get_latest_id()), False
+        except LogCorruptedError:
+            return None, False
+    log_manager.create_latest_stable_log(latest_id + 1)
+    return entry, True
+
+
+def ensure_recovered(
+    log_manager: IndexLogManager,
+    lease_ms: int = RECOVERY_LEASE_MS_DEFAULT,
+    now: Optional[int] = None,
+) -> Dict[str, object]:
+    """Detect and repair a dead writer's leavings at the log tip.
+
+    Three cases, all idempotent:
+
+    * latest entry stable but the latestStable pointer behind/missing
+      (crash between end-log and publish) → re-publish the pointer;
+    * latest entry transient/torn with an EXPIRED lease → rollback;
+    * latest entry transient with a LIVE lease → leave it alone (a slow
+      writer is not a dead one) and report it.
+
+    Returns a report dict: ``rolled_back``, ``healed_pointer``,
+    ``live_writer`` (bool each) + ``latest_state``.
+    """
+    report: Dict[str, object] = {
+        "rolled_back": False,
+        "healed_pointer": False,
+        "live_writer": False,
+        "latest_state": None,
+    }
+    latest_id = log_manager.get_latest_id()
+    if latest_id is None:
+        return report
+    try:
+        latest = log_manager.get_log(latest_id)
+    except LogCorruptedError:
+        latest = None
+    if latest is not None and latest.state in States.STABLE_STATES:
+        report["latest_state"] = latest.state
+        if log_manager.get_latest_stable_pointer_id() != latest_id:
+            log_manager.create_latest_stable_log(latest_id)
+            report["healed_pointer"] = True
+        return report
+    if not is_stranded(latest, lease_ms, now):
+        report["latest_state"] = latest.state
+        report["live_writer"] = True
+        return report
+    rolled, _we_wrote = rollback(log_manager, latest_id)
+    # either way the tip is repaired — by us or by the competitor whose
+    # write beat ours; auto-recovery only cares that it IS repaired
+    report["rolled_back"] = True
+    report["latest_state"] = rolled.state if rolled is not None else None
+    return report
+
+
+# ---------------------------------------------------------------------------
+# Serve snapshot pins (GC coordination)
+# ---------------------------------------------------------------------------
+
+_pins_lock = threading.Lock()
+_active_pins: Dict[int, frozenset] = {}
+_pin_seq = 0
+
+
+def register_pins(entries: Optional[Iterable[IndexLogEntry]]) -> int:
+    """Record the index files a serve snapshot depends on; returns a
+    token for :func:`release_pins`. GC never quarantines a pinned file,
+    so a query that pinned its snapshot before a version went
+    unreferenced still finds every byte."""
+    files: Set[str] = set()
+    for e in entries or ():
+        files.update(p.replace("\\", "/") for p in e.content.files)
+    global _pin_seq
+    with _pins_lock:
+        _pin_seq += 1
+        token = _pin_seq
+        _active_pins[token] = frozenset(files)
+    return token
+
+
+def release_pins(token: int) -> None:
+    with _pins_lock:
+        _active_pins.pop(token, None)
+
+
+def pinned_files() -> Set[str]:
+    """Union of all currently pinned index files (normalized paths)."""
+    with _pins_lock:
+        snapshots = list(_active_pins.values())
+    out: Set[str] = set()
+    for s in snapshots:
+        out |= s
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Orphan GC
+# ---------------------------------------------------------------------------
+
+
+def _referenced_files(log_manager: IndexLogManager) -> Set[str]:
+    """Every data file any parseable STABLE entry references. Stable
+    entries are the only ones whose content is a promise — a transient
+    entry's content either becomes stable (then its files appear there
+    too) or gets rolled back (then its files are exactly the orphans)."""
+    out: Set[str] = set()
+    latest = log_manager.get_latest_id()
+    if latest is None:
+        return out
+    for log_id in range(latest, -1, -1):
+        try:
+            entry = log_manager.get_log(log_id)
+        except LogCorruptedError:
+            continue
+        if entry is not None and entry.state in States.STABLE_STATES:
+            out.update(p.replace("\\", "/") for p in entry.content.files)
+    return out
+
+
+def find_orphans(index_path: str) -> List[str]:
+    """Data files under the index's version dirs that no stable log
+    entry references (quarantine excluded). The zero-orphans assert of
+    the crash matrix and the chaos harness."""
+    log_manager = IndexLogManager(index_path)
+    if log_manager.get_latest_id() is None:
+        return []
+    referenced = _referenced_files(log_manager)
+    orphans: List[str] = []
+    for name in sorted(os.listdir(index_path)):
+        if name in (HYPERSPACE_LOG_DIR, HYPERSPACE_QUARANTINE_DIR):
+            continue
+        root = os.path.join(index_path, name)
+        if not os.path.isdir(root):
+            continue
+        for p, _size, _mtime in file_utils.list_leaf_files(root):
+            norm = p.replace("\\", "/")
+            if path_utils.is_data_path(norm) and norm not in referenced:
+                orphans.append(norm)
+    return orphans
+
+
+def gc_orphans(
+    index_path: str,
+    grace_ms: int = RECOVERY_ORPHAN_GRACE_MS_DEFAULT,
+    now: Optional[int] = None,
+    lease_ms: int = RECOVERY_LEASE_MS_DEFAULT,
+) -> Dict[str, object]:
+    """Quarantine-then-delete unreferenced index data files.
+
+    Two phases, each idempotent:
+
+    1. every data file under a version dir that no stable entry
+       references — and no live in-process serve pin names — MOVES to
+       ``_hyperspace_quarantine/<now_ms>/`` (directories left with no
+       data files go wholesale, sidecars and all);
+    2. quarantine stamps older than ``grace_ms`` are deleted.
+
+    A LIVE writer (transient log tip whose lease has not expired) skips
+    phase 1 entirely: its half-written version dir is referenced by no
+    entry yet, and no per-file test can tell its work from a dead
+    writer's leavings — only the lease can. Phase 2 still purges old
+    stamps.
+
+    With ``grace_ms=0`` the sweep is immediate (tests, the chaos
+    harness); production keeps the default TTL so out-of-process
+    readers of a just-vacated version get the grace window the
+    in-process pin registry gives local queries.
+    """
+    now = now_ms() if now is None else now
+    log_manager = IndexLogManager(index_path)
+    report: Dict[str, object] = {
+        "quarantined_files": 0,
+        "quarantined_dirs": 0,
+        "kept_pinned": 0,
+        "purged_stamps": 0,
+        "skipped_live_writer": False,
+    }
+    latest_id = log_manager.get_latest_id()
+    if latest_id is None:
+        return report
+    try:
+        tip = log_manager.get_log(latest_id)
+    except LogCorruptedError:
+        tip = None
+    if (
+        tip is not None
+        and tip.state not in States.STABLE_STATES
+        and not is_stranded(tip, lease_ms, now)
+    ):
+        report["skipped_live_writer"] = True
+        _purge_quarantine(index_path, grace_ms, now, report)
+        return report
+    referenced = _referenced_files(log_manager)
+    pinned = pinned_files()
+    quarantine_root = os.path.join(index_path, HYPERSPACE_QUARANTINE_DIR)
+    stamp_dir = os.path.join(quarantine_root, str(now))
+
+    def _move(src: str) -> None:
+        rel = os.path.relpath(src, index_path)
+        dst = os.path.join(stamp_dir, rel)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        shutil.move(src, dst)
+
+    for name in sorted(os.listdir(index_path)):
+        if name in (HYPERSPACE_LOG_DIR, HYPERSPACE_QUARANTINE_DIR):
+            continue
+        root = os.path.join(index_path, name)
+        if not os.path.isdir(root):
+            continue
+        listed = file_utils.list_leaf_files(root)
+        data = [
+            p.replace("\\", "/")
+            for p, _s, _m in listed
+            if path_utils.is_data_path(p)
+        ]
+        live = [p for p in data if p in referenced]
+        doomed = [p for p in data if p not in referenced and p not in pinned]
+        report["kept_pinned"] += sum(
+            1 for p in data if p not in referenced and p in pinned
+        )
+        if not live and len(doomed) == len(data):
+            # nothing referenced or pinned survives in this version dir:
+            # take the whole dir, sidecars included
+            if data or listed:
+                _move(root)
+                report["quarantined_dirs"] += 1
+            continue
+        for p in doomed:
+            _move(p)
+            report["quarantined_files"] += 1
+
+    _purge_quarantine(index_path, grace_ms, now, report)
+    return report
+
+
+def _purge_quarantine(
+    index_path: str, grace_ms: int, now: int, report: Dict[str, object]
+) -> None:
+    """Phase 2: delete quarantine stamps older than the grace TTL."""
+    quarantine_root = os.path.join(index_path, HYPERSPACE_QUARANTINE_DIR)
+    if not os.path.isdir(quarantine_root):
+        return
+    for stamp in sorted(os.listdir(quarantine_root)):
+        try:
+            stamped_at = int(stamp)
+        except ValueError:
+            continue
+        if stamped_at + grace_ms <= now:
+            file_utils.delete(os.path.join(quarantine_root, stamp))
+            report["purged_stamps"] += 1
+    if not os.listdir(quarantine_root):
+        file_utils.delete(quarantine_root)

@@ -19,7 +19,10 @@ boundary:
   versions inside one query. Index version file sets are immutable, so
   the pinned plan stays readable until a vacuum physically removes the
   old version — which surfaces as an I/O error and is healed by the
-  retry below (re-pin + re-plan on the current snapshot).
+  retry below (re-pin + re-plan on the current snapshot). Each pin is
+  also registered with the recovery plane
+  (``metadata/recovery.register_pins``) for the life of the query, so
+  orphan GC never quarantines a file a live serve still reads.
 
 * **Retry / degrade at the operation boundary** (Exoshuffle doctrine:
   fault handling belongs in the application-level dataflow). TRANSIENT
@@ -61,6 +64,7 @@ from hyperspace_tpu.exceptions import (
     HyperspaceException,
     ServeOverloadedError,
 )
+from hyperspace_tpu.metadata import recovery
 from hyperspace_tpu.plan.nodes import LogicalPlan
 from hyperspace_tpu.testing.faults import InjectedFault
 
@@ -180,6 +184,11 @@ class ServeFrontend:
         with self._lock:
             self._check_admittable()
         pin = self._pin()
+        # register the pinned snapshot's files with the recovery plane:
+        # orphan GC (metadata/recovery.gc_orphans) never quarantines a
+        # pinned file, so a version that goes unreferenced mid-query
+        # stays readable until the query releases it (_run's finally)
+        pin_token = recovery.register_pins(pin)
         fp = (
             plan_fingerprint(plan),
             self._session.conf.version,
@@ -187,16 +196,21 @@ class ServeFrontend:
             if pin is None
             else tuple((e.name, e.id) for e in pin),
         )
-        with self._lock:
-            existing = self._inflight.get(fp)
-            if existing is not None:
-                self._deduped += 1
-                return existing
-            self._check_admittable()
-            self._queued += 1
-            self._admitted += 1
-            fut = self._pool.submit(self._run, plan, pin)
-            self._inflight[fp] = fut
+        try:
+            with self._lock:
+                existing = self._inflight.get(fp)
+                if existing is not None:
+                    self._deduped += 1
+                    recovery.release_pins(pin_token)
+                    return existing
+                self._check_admittable()
+                self._queued += 1
+                self._admitted += 1
+                fut = self._pool.submit(self._run, plan, pin, pin_token)
+                self._inflight[fp] = fut
+        except BaseException:
+            recovery.release_pins(pin_token)
+            raise
         fut.add_done_callback(lambda _f, fp=fp: self._forget(fp))
         return fut
 
@@ -230,7 +244,7 @@ class ServeFrontend:
             optimized = apply_hyperspace(session, plan, entries=list(pin))
         return execute(optimized, session)
 
-    def _run(self, plan: LogicalPlan, pin: Optional[Tuple]):
+    def _run(self, plan: LogicalPlan, pin: Optional[Tuple], pin_token: int):
         with self._lock:
             self._queued -= 1
         session = self._session
@@ -238,40 +252,46 @@ class ServeFrontend:
         backoff = session.conf.serve_retry_backoff_ms / 1000.0
         t_start = time.perf_counter()
         attempt = 1
-        while True:
-            try:
-                out = self._execute_pinned(plan, pin)
-                self._record(t_start)
-                return out
-            except Exception as exc:  # classified below; always re-raised
-                if _is_transient(exc) and attempt < attempts:
-                    attempt += 1
-                    with self._lock:
-                        self._retries += 1
-                    if backoff > 0:
-                        time.sleep(backoff * (1 << (attempt - 2)))
-                    # re-pin: a vacuum may have removed the pinned
-                    # version's files; the current snapshot serves
-                    pin = self._pin()
-                    continue
-                if isinstance(exc, OSError) and pin:
-                    # persistent I/O failure of the index-rewritten
-                    # query: degrade to the unrewritten plan (source
-                    # data; bit-identical result — the covering-index
-                    # equivalence the differential suite guarantees)
-                    with self._lock:
-                        self._degraded += 1
-                    try:
-                        out = self._execute_pinned(plan, ())
-                    except Exception:
-                        with self._lock:
-                            self._failed += 1
-                        raise exc from None
+        try:
+            while True:
+                try:
+                    out = self._execute_pinned(plan, pin)
                     self._record(t_start)
                     return out
-                with self._lock:
-                    self._failed += 1
-                raise
+                except Exception as exc:  # classified below; always re-raised
+                    if _is_transient(exc) and attempt < attempts:
+                        attempt += 1
+                        with self._lock:
+                            self._retries += 1
+                        if backoff > 0:
+                            time.sleep(backoff * (1 << (attempt - 2)))
+                        # re-pin: a vacuum may have removed the pinned
+                        # version's files; the current snapshot serves.
+                        # Swap the GC pin along with it.
+                        recovery.release_pins(pin_token)
+                        pin = self._pin()
+                        pin_token = recovery.register_pins(pin)
+                        continue
+                    if isinstance(exc, OSError) and pin:
+                        # persistent I/O failure of the index-rewritten
+                        # query: degrade to the unrewritten plan (source
+                        # data; bit-identical result — the covering-index
+                        # equivalence the differential suite guarantees)
+                        with self._lock:
+                            self._degraded += 1
+                        try:
+                            out = self._execute_pinned(plan, ())
+                        except Exception:
+                            with self._lock:
+                                self._failed += 1
+                            raise exc from None
+                        self._record(t_start)
+                        return out
+                    with self._lock:
+                        self._failed += 1
+                    raise
+        finally:
+            recovery.release_pins(pin_token)
 
     def _record(self, t_start: float) -> None:
         dt = time.perf_counter() - t_start

@@ -50,14 +50,77 @@ Everything is process-global and thread-safe: the serve plane is
 multi-threaded and a fault armed by the admitting thread must fire in
 scan-pool workers. When nothing is armed the per-call cost is one dict
 truthiness check.
+
+Crash points (``hyperspace.faults.crash.<point>``) are the lifecycle
+counterpart: named points inside every Action where a writer can die
+mid-protocol, leaving a stranded transient log entry and orphan data
+files for ``metadata/recovery.py`` to clean up. Spec grammar::
+
+    "raise"            raise SimulatedCrash at the point (in-process
+                       torn-state tests; tier-1 speed)
+    "exit"             os._exit(CRASH_EXIT_CODE) — the process REALLY
+                       dies mid-protocol, no finally blocks, no heartbeat
+                       shutdown: the true torn state (slow-marked
+                       subprocess tests)
+    "raise;at=3"       fire on the 3rd matching call (crash after two
+                       bucket files landed, mid version dir)
+    "raise;match=v__=2"  only calls whose detail contains the substring
+
+========================  ====================================================
+crash point               armed site
+========================  ====================================================
+``after_begin_log``       ``actions/base.py`` — begin entry published,
+                          before any data work (and before the lease
+                          heartbeat starts)
+``mid_data_write``        ``io/parquet.py`` bucket/table writes — between
+                          index data files of the new version dir
+``after_data_write``      ``actions/base.py`` — op() done, end entry not
+                          yet written
+``after_end_log``         ``actions/base.py`` — end entry committed,
+                          latestStable pointer not yet republished
+``mid_vacuum_delete``     ``actions/vacuum.py`` — between file deletes of
+                          a vacuum / vacuum-outdated sweep
+========================  ====================================================
+
+A crash point is ONE-SHOT in ``raise`` mode: it disarms itself when it
+fires, so the recovery/retry that follows does not crash again.
+:class:`SimulatedCrash` subclasses ``BaseException`` (like
+``KeyboardInterrupt``): no ``except Exception`` cleanup handler may
+swallow it, because a real crash would not have run that handler either.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Dict, Optional
 
 POINTS = ("parquet_read", "kernel_dispatch", "log_read", "cache_insert")
+
+CRASH_POINTS = (
+    "after_begin_log",
+    "mid_data_write",
+    "after_data_write",
+    "after_end_log",
+    "mid_vacuum_delete",
+)
+
+#: ``exit``-mode crash status — distinctive, so a subprocess test can tell
+#: a simulated crash from an ordinary failure of the child.
+CRASH_EXIT_CODE = 86
+
+
+class SimulatedCrash(BaseException):
+    """An armed crash point fired in ``raise`` mode.
+
+    Deliberately NOT an ``Exception``: the whole point is modeling a
+    process death, and a ``try/except Exception`` that tidied up on the
+    way out would be rehearsing a cleanup the real crash never runs.
+    """
+
+    def __init__(self, point: str):
+        super().__init__(f"simulated crash at {point}")
+        self.point = point
 
 
 class InjectedFault(OSError):
@@ -107,10 +170,36 @@ class _FaultPoint:
         return True
 
 
+class _CrashPoint:
+    """One armed crash point: fire mode (raise/exit), the 1-based call
+    ordinal it fires at, substring filter. One-shot in raise mode."""
+
+    def __init__(self, point: str, exit_: bool, at: int, match: Optional[str]):
+        self.point = point
+        self.exit = exit_
+        self.at = at
+        self.match = match
+        self.calls = 0
+
+    def fire(self, detail: str) -> bool:
+        if self.match and self.match not in detail:
+            return False
+        with _lock:
+            self.calls += 1
+            if self.calls != self.at:
+                return False
+            _fired_totals["crash." + self.point] = (
+                _fired_totals.get("crash." + self.point, 0) + 1
+            )
+        return True
+
+
 _lock = threading.Lock()
 _active: Dict[str, _FaultPoint] = {}
+_crash_active: Dict[str, _CrashPoint] = {}
 # totals survive disarm/re-arm so a suite can assert "every point fired
 # at least once" at the end of a run that armed points one at a time
+# (crash points count under a "crash." prefix)
 _fired_totals: Dict[str, int] = {}
 
 
@@ -158,15 +247,62 @@ def set_fault(point: str, spec: str) -> bool:
         return True
 
 
+def parse_crash_spec(spec: str):
+    """``(exit, at, match)`` from a crash spec string, or None for
+    off/empty. Same loud-on-typo stance as :func:`parse_spec`."""
+    s = str(spec).strip()
+    if not s or s.lower() == "off":
+        return None
+    match = None
+    at = 1
+    parts = s.split(";")
+    for opt in parts[1:]:
+        k, _, v = opt.partition("=")
+        k = k.strip()
+        if k == "match" and v:
+            match = v
+        elif k == "at":
+            at = int(v)
+            if at <= 0:
+                raise ValueError(f"crash at= must be positive: {spec!r}")
+        else:
+            raise ValueError(f"bad crash option {opt!r} in {spec!r}")
+    mode = parts[0].strip().lower()
+    if mode not in ("raise", "exit"):
+        raise ValueError(f"unknown crash mode {mode!r} in {spec!r}")
+    return mode == "exit", at, match
+
+
+def set_crash(point: str, spec: str) -> bool:
+    """Arm (or disarm, spec="off") one crash point. Returns True when
+    the point was armed."""
+    if point not in CRASH_POINTS:
+        raise ValueError(f"unknown crash point {point!r}; have {CRASH_POINTS}")
+    parsed = parse_crash_spec(spec)
+    with _lock:
+        if parsed is None:
+            _crash_active.pop(point, None)
+            return False
+        exit_, at, match = parsed
+        _crash_active[point] = _CrashPoint(point, exit_, at, match)
+        return True
+
+
 def configure(conf) -> int:
-    """Arm every ``hyperspace.faults.<point>`` key present in a session
-    config (:meth:`Config.prefixed`). Returns the number of armed
-    points. Unlisted points are left untouched — call :func:`clear`
-    first for a clean slate."""
-    from hyperspace_tpu.constants import FAULTS_KEY_PREFIX
+    """Arm every ``hyperspace.faults.<point>`` /
+    ``hyperspace.faults.crash.<point>`` key present in a session config
+    (:meth:`Config.prefixed`). Returns the number of armed points.
+    Unlisted points are left untouched — call :func:`clear` first for a
+    clean slate."""
+    from hyperspace_tpu.constants import CRASH_KEY_PREFIX, FAULTS_KEY_PREFIX
 
     n = 0
+    for key, spec in conf.prefixed(CRASH_KEY_PREFIX).items():
+        if set_crash(key[len(CRASH_KEY_PREFIX):], str(spec)):
+            n += 1
     for key, spec in conf.prefixed(FAULTS_KEY_PREFIX).items():
+        if key.startswith(CRASH_KEY_PREFIX):
+            continue
         point = key[len(FAULTS_KEY_PREFIX):]
         if set_fault(point, str(spec)):
             n += 1
@@ -177,12 +313,14 @@ def clear() -> None:
     """Disarm every point (fired totals are kept; see module doc)."""
     with _lock:
         _active.clear()
+        _crash_active.clear()
 
 
 def reset() -> None:
     """Disarm every point AND zero the fired totals (test isolation)."""
     with _lock:
         _active.clear()
+        _crash_active.clear()
         _fired_totals.clear()
 
 
@@ -211,7 +349,27 @@ def degraded(point: str, detail="") -> bool:
     return fp is not None and fp.fire(str(detail))
 
 
+def crash(point: str, detail="") -> None:
+    """Die at ``point`` when armed: raise :class:`SimulatedCrash`
+    (``raise`` mode, one-shot — the point disarms itself so the
+    recovery/retry that follows runs clean) or ``os._exit`` (``exit``
+    mode — the process really dies, skipping every finally block, exit
+    handler and lease heartbeat, the way a kill -9 would). No-op (one
+    dict truthiness check) when nothing is armed."""
+    if not _crash_active:
+        return
+    cp = _crash_active.get(point)
+    if cp is None or not cp.fire(str(detail)):
+        return
+    if cp.exit:
+        os._exit(CRASH_EXIT_CODE)
+    with _lock:
+        _crash_active.pop(point, None)
+    raise SimulatedCrash(point)
+
+
 def stats() -> Dict[str, int]:
-    """Cumulative fired count per point (across disarm/re-arm)."""
+    """Cumulative fired count per point (across disarm/re-arm); crash
+    points appear as ``crash.<point>``."""
     with _lock:
         return dict(_fired_totals)

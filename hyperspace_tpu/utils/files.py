@@ -26,6 +26,28 @@ def read_text(path: str) -> str:
         return f.read()
 
 
+def fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY: durably record its entries.
+
+    File fsync alone does not survive a dirent-loss crash on ext4 — the
+    journal can commit the file's data while the directory entry that
+    names it is still only in memory, so a crash right after an atomic
+    publish can un-publish the name. Called after every link/replace
+    that publishes a log entry. Best-effort: some filesystems (FUSE
+    object-store mounts) reject directory fsync — there the rename
+    itself is the durability point and this is a no-op."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def atomic_write_if_absent(path: str, text: str) -> bool:
     """Create ``path`` with ``text`` iff it does not exist; atomic.
 
@@ -56,6 +78,7 @@ def atomic_write_if_absent(path: str, text: str) -> bool:
             os.fsync(f.fileno())
         try:
             os.link(tmp, path)
+            fsync_dir(d)
             return True
         except FileExistsError:
             return False
@@ -69,6 +92,7 @@ def atomic_write_if_absent(path: str, text: str) -> bool:
                     f.write(text)
                     f.flush()
                     os.fsync(f.fileno())
+                fsync_dir(d)
                 return True
             except FileExistsError:
                 return False
@@ -92,6 +116,7 @@ def atomic_overwrite(path: str, text: str) -> None:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
+        fsync_dir(d)
     except BaseException:
         try:
             os.unlink(tmp)

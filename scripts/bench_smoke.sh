@@ -83,6 +83,18 @@ for point in ("parquet_read", "kernel_dispatch", "log_read", "cache_insert"):
     assert fi["fired"].get(point, 0) >= 1, (point, fi)
 assert fi["frontend_failed"] == 0, fi
 assert fi["frontend_retries"] >= 1 and fi["frontend_degraded"] >= 1, fi
+# the chaos rung (crash-safe lifecycle, docs/recovery.md) must have
+# crashed at least one writer and recovered with ZERO stranded log
+# entries, ZERO orphan files after GC and ZERO serve mismatches vs the
+# crash-free replica
+ch = d["chaos"]
+assert ch["crashes_fired"] >= 1, ch
+assert ch["rolled_back"] >= 1, ch
+assert ch["stranded_after_recovery"] == 0, ch
+assert ch["orphans_after_gc"] == 0, ch
+assert ch["serve_mismatches"] == 0, ch
+assert ch["serves_verified"] >= 1, ch
+print("bench_smoke: chaos recovery ok:", ch, file=sys.stderr)
 print("bench_smoke: serve concurrency ok:",
       {c: (sc[c]["p50_ms"], sc[c]["p99_ms"], sc[c]["qps"]) for c in sc},
       file=sys.stderr)

@@ -1,0 +1,76 @@
+"""Chaos schedule harness (testing/chaos.py): randomized lifecycles
+under injected crashes, asserting the full recovery contract — stable
+log after recovery, serves bit-identical to a crash-free replica, zero
+orphans after GC.
+
+Tier-1 runs a short schedule with a few crash cells; the full
+(lifecycle step × crash point) sweep is slow-marked and also runs — at
+small scale — as the ``bench.py`` chaos rung that
+``scripts/bench_smoke.sh`` gates on.
+"""
+
+import pytest
+
+from hyperspace_tpu.testing import faults
+from hyperspace_tpu.testing.chaos import (
+    ChaosHarness,
+    build_schedule,
+    run_crash_matrix,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def test_schedule_is_deterministic_and_legal():
+    a = build_schedule(7, 14)
+    assert a == build_schedule(7, 14)
+    assert a[0] == ("create",)
+    # every refresh is immediately preceded by an append (cannot no-op)
+    for i, step in enumerate(a):
+        if step[0].startswith("refresh"):
+            assert a[i - 1][0] == "append"
+
+
+def test_clean_run_green(tmp_path):
+    h = ChaosHarness(str(tmp_path), seed=1, n_steps=10)
+    rep = h.run(run_name="clean")
+    assert rep.serve_results, "schedule produced no serves"
+    assert rep.stranded_after == 0
+    assert rep.orphans_after_gc == 0
+    assert rep.crashes_fired == 0
+
+
+@pytest.mark.parametrize(
+    ("cell", "point"),
+    [
+        (0, "after_begin_log"),     # crash the create
+        (1, "mid_data_write"),      # crash a data-writing lifecycle op
+        (1, "after_end_log"),       # committed-but-unpublished
+    ],
+)
+def test_crash_cells_recover_and_match_replica(tmp_path, cell, point):
+    h = ChaosHarness(str(tmp_path), seed=2, n_steps=10)
+    clean = h.run(run_name="clean")
+    rep = h.run(crash_step=cell, crash_point=point)
+    assert rep.crashes_fired + rep.crashes_skipped == 1
+    assert rep.stranded_after == 0
+    assert rep.orphans_after_gc == 0
+    assert len(rep.serve_results) == len(clean.serve_results)
+    for got, want in zip(rep.serve_results, clean.serve_results):
+        assert got.equals(want)
+
+
+@pytest.mark.slow
+def test_full_crash_matrix_slow(tmp_path):
+    summary = run_crash_matrix(str(tmp_path), seed=5, n_steps=12)
+    assert summary["cells"] > 0
+    assert summary["crashes_fired"] >= summary["lifecycle_steps"]
+    assert summary["stranded_after_recovery"] == 0
+    assert summary["orphans_after_gc"] == 0
+    assert summary["serve_mismatches"] == 0
+    assert summary["serves_verified"] > 0

@@ -1,0 +1,854 @@
+"""Crash-point injection + stranded-action recovery (docs/recovery.md).
+
+The tested contract (ISSUE 10): for every action × crash point cell of
+the matrix, a writer killed at that point leaves the log recoverable —
+after recovery the log tip is STABLE, a serve answers identically to
+the unindexed truth, orphan GC returns the index directory's data file
+set to exactly what a crash-free history would hold, and a retried
+action completes. hslint HS703 requires every ``CRASH_POINTS`` entry to
+appear in this file.
+
+Tier-1 runs the in-process ``SimulatedCrash`` matrix; the ``os._exit``
+subprocess variants (true torn state: no finally blocks, no heartbeat
+shutdown) are slow-marked.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.constants import States
+from hyperspace_tpu.exceptions import (
+    ConcurrentWriteException,
+    HyperspaceException,
+    LogCorruptedError,
+)
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+from hyperspace_tpu.metadata import recovery
+from hyperspace_tpu.testing import faults
+from hyperspace_tpu.testing.faults import SimulatedCrash
+from hyperspace_tpu.utils import files as file_utils
+from hyperspace_tpu.utils.paths import is_data_path
+
+LEASE_MS = 40
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def wait_lease():
+    time.sleep(LEASE_MS * 2.5 / 1000.0)
+
+
+def sorted_table(t):
+    return t.sort_by([(c, "ascending") for c in t.column_names])
+
+
+def append_file(src, name="extra", clicks=(9001, 9002, 9003)):
+    t = pa.table(
+        {
+            "date": ["2018-02-02"] * len(clicks),
+            "rguid": [f"g{i}" for i in range(len(clicks))],
+            "clicks": pa.array(list(clicks), pa.int64()),
+            "query": ["appended"] * len(clicks),
+            "imprs": pa.array(list(range(len(clicks))), pa.int64()),
+        }
+    )
+    pq.write_table(t, os.path.join(src, f"part-{name}.parquet"))
+
+
+def data_files(index_path):
+    """Data file set under the index's version dirs (quarantine and log
+    excluded) — the clean-build equivalence check."""
+    out = set()
+    if not os.path.isdir(index_path):
+        return out
+    for name in os.listdir(index_path):
+        if name in (C.HYPERSPACE_LOG_DIR, C.HYPERSPACE_QUARANTINE_DIR):
+            continue
+        root = os.path.join(index_path, name)
+        if not os.path.isdir(root):
+            continue
+        for p, _s, _m in file_utils.list_leaf_files(root):
+            if is_data_path(p):
+                out.add(p.replace("\\", "/"))
+    return out
+
+
+@pytest.fixture
+def env(session_factory, sample_parquet):
+    s = session_factory(1)
+    s.conf.set(C.RECOVERY_LEASE_MS, LEASE_MS)
+    s.conf.set(C.RECOVERY_ORPHAN_GRACE_MS, 0)
+    s.conf.set(C.INDEX_LINEAGE_ENABLED, True)
+    return s, Hyperspace(s), sample_parquet
+
+
+def assert_serve_matches_source(session, src):
+    df = session.read.parquet(src)
+    q = df.filter(df["clicks"] >= 500).select("clicks", "query")
+    session.index_manager.clear_cache()
+    session.disable_hyperspace()
+    base = q.collect()
+    session.enable_hyperspace()
+    got = q.collect()
+    assert sorted_table(got).equals(sorted_table(base))
+    session.disable_hyperspace()
+
+
+# ---------------------------------------------------------------------------
+# Crash registry
+# ---------------------------------------------------------------------------
+
+
+class TestCrashRegistry:
+    def test_spec_parsing(self):
+        assert faults.parse_crash_spec("off") is None
+        assert faults.parse_crash_spec("") is None
+        assert faults.parse_crash_spec("raise") == (False, 1, None)
+        assert faults.parse_crash_spec("exit") == (True, 1, None)
+        assert faults.parse_crash_spec("raise;at=3") == (False, 3, None)
+        assert faults.parse_crash_spec("exit;match=v__=2") == (
+            True,
+            1,
+            "v__=2",
+        )
+        for bad in ("boom", "raise;at=0", "raise;x=1"):
+            with pytest.raises(ValueError):
+                faults.parse_crash_spec(bad)
+        with pytest.raises(ValueError):
+            faults.set_crash("not_a_point", "raise")
+
+    def test_raise_is_one_shot(self):
+        faults.set_crash("after_begin_log", "raise")
+        with pytest.raises(SimulatedCrash) as ei:
+            faults.crash("after_begin_log", "CreateAction")
+        assert ei.value.point == "after_begin_log"
+        # disarmed itself: recovery running the same seam must not die
+        faults.crash("after_begin_log", "CreateAction")
+        assert faults.stats() == {"crash.after_begin_log": 1}
+
+    def test_at_and_match(self):
+        faults.set_crash("mid_data_write", "raise;at=2;match=special")
+        faults.crash("mid_data_write", "/other/f1")  # no match
+        faults.crash("mid_data_write", "/special/f1")  # call 1 of 2
+        with pytest.raises(SimulatedCrash):
+            faults.crash("mid_data_write", "/special/f2")
+
+    def test_simulated_crash_is_not_exception(self):
+        # an `except Exception` cleanup handler must never swallow a
+        # simulated process death
+        assert issubclass(SimulatedCrash, BaseException)
+        assert not issubclass(SimulatedCrash, Exception)
+
+    def test_configure_routes_crash_keys(self):
+        from hyperspace_tpu.config import Config
+
+        conf = Config()
+        conf.set(C.CRASH_KEY_PREFIX + "after_end_log", "raise")
+        conf.set(C.FAULTS_KEY_PREFIX + "log_read", "transient")
+        assert faults.configure(conf) == 2
+        with pytest.raises(SimulatedCrash):
+            faults.crash("after_end_log")
+        with pytest.raises(faults.InjectedFault):
+            faults.check("log_read", "p")
+
+
+# ---------------------------------------------------------------------------
+# Recovery unit behavior: leases, rollback, healing, GC
+# ---------------------------------------------------------------------------
+
+
+class TestRecoveryUnit:
+    def _mk_index(self, env):
+        s, hs, src = env
+        df = s.read.parquet(src)
+        hs.create_index(df, CoveringIndexConfig("idx", ["clicks"], ["query"]))
+        log_mgr, _ = s.index_manager._managers("idx")
+        return s, hs, src, log_mgr
+
+    def test_lease_stamped_and_heartbeat_renews(self, env, monkeypatch):
+        s, hs, src, log_mgr = self._mk_index(env)
+        append_file(src)
+        from hyperspace_tpu.actions import refresh as refresh_mod
+
+        seen = {}
+        orig_op = refresh_mod.RefreshAction.op
+
+        def slow_op(self):
+            first = log_mgr.get_log(self.base_id + 1)
+            # op outlives several heartbeat intervals; the lease must
+            # have been re-stamped with a later expiry by the end
+            time.sleep(LEASE_MS * 2.0 / 1000.0)
+            seen["first"] = recovery.lease_expires_at(first, 0)
+            seen["later"] = recovery.lease_expires_at(
+                log_mgr.get_log(self.base_id + 1), 0
+            )
+            seen["owner"] = first.properties.get(recovery.LEASE_OWNER_PROP)
+            return orig_op(self)
+
+        monkeypatch.setattr(refresh_mod.RefreshAction, "op", slow_op)
+        hs.refresh_index("idx", "full")
+        assert seen["owner"]
+        assert seen["later"] > seen["first"]
+        # committed entries carry no lease
+        assert (
+            recovery.LEASE_OWNER_PROP
+            not in log_mgr.get_latest_log().properties
+        )
+
+    def test_live_lease_blocks_auto_recovery(self, env):
+        s, hs, src, log_mgr = self._mk_index(env)
+        stable = log_mgr.get_latest_stable_log()
+        stranded = stable.with_state(States.REFRESHING)
+        recovery.stamp_lease(stranded, "w1", 60_000)
+        assert log_mgr.write_log(log_mgr.get_latest_id() + 1, stranded)
+        rep = recovery.ensure_recovered(log_mgr, lease_ms=60_000)
+        assert rep["live_writer"] and not rep["rolled_back"]
+        # once expired, the same entry rolls back
+        rep = recovery.ensure_recovered(
+            log_mgr, lease_ms=60_000, now=recovery.now_ms() + 120_000
+        )
+        assert rep["rolled_back"]
+        assert log_mgr.get_latest_log().state == States.ACTIVE
+
+    def test_rollback_occ_two_recoverers_single_roll(self, env):
+        s, hs, src, log_mgr = self._mk_index(env)
+        stable = log_mgr.get_latest_stable_log()
+        tip = log_mgr.get_latest_id() + 1
+        stranded = stable.with_state(States.OPTIMIZING)
+        recovery.stamp_lease(stranded, "dead", 1)
+        assert log_mgr.write_log(tip, stranded)
+        wait_lease()
+        # recoverer B wins the rollback id first
+        other = stable.copy()
+        assert log_mgr.write_log(tip + 1, other)
+        # recoverer A loses the OCC race gracefully: no double-roll,
+        # and the status says the survivor is B's write, not A's
+        rolled, we_wrote = recovery.rollback(log_mgr, tip)
+        assert rolled is not None and rolled.state == States.ACTIVE
+        assert not we_wrote
+        assert log_mgr.get_latest_id() == tip + 1
+
+    def test_stale_pointer_healed(self, env):
+        s, hs, src, log_mgr = self._mk_index(env)
+        append_file(src)
+        hs.refresh_index("idx", "full")
+        latest = log_mgr.get_latest_id()
+        # simulate a crash between end-log and publish: pointer rewound
+        log_mgr.create_latest_stable_log(latest - 2)
+        assert log_mgr.get_latest_stable_pointer_id() == latest - 2
+        rep = recovery.ensure_recovered(log_mgr, LEASE_MS)
+        assert rep["healed_pointer"]
+        assert log_mgr.get_latest_stable_pointer_id() == latest
+
+    def test_gc_skips_live_writer_version_dir(self, env):
+        """GC must never quarantine a LIVE writer's half-written files:
+        they are referenced by no entry yet, and only the lease can tell
+        in-progress work from a dead writer's leavings."""
+        s, hs, src, log_mgr = self._mk_index(env)
+        index_path = log_mgr.index_path
+        # simulate a writer mid-op: transient tip with a live lease and
+        # an unreferenced in-progress version dir
+        stable = log_mgr.get_latest_stable_log()
+        busy = stable.with_state(States.REFRESHING)
+        recovery.stamp_lease(busy, "live", 60_000)
+        assert log_mgr.write_log(log_mgr.get_latest_id() + 1, busy)
+        wip_dir = os.path.join(index_path, "v__=2")
+        os.makedirs(wip_dir)
+        wip = os.path.join(wip_dir, "part-wip.parquet")
+        with open(wip, "w") as f:
+            f.write("x")
+        rep = recovery.gc_orphans(index_path, grace_ms=0, lease_ms=60_000)
+        assert rep["skipped_live_writer"]
+        assert rep["quarantined_files"] == 0 and rep["quarantined_dirs"] == 0
+        assert os.path.isfile(wip)
+        # once the lease expires the same files are fair game
+        rep = recovery.gc_orphans(
+            index_path, grace_ms=0, lease_ms=60_000,
+            now=recovery.now_ms() + 120_000,
+        )
+        assert not rep["skipped_live_writer"]
+        assert rep["quarantined_dirs"] == 1
+        assert not os.path.exists(wip)
+
+    def test_gc_respects_pins_and_grace(self, env):
+        s, hs, src, log_mgr = self._mk_index(env)
+        index_path = log_mgr.index_path
+        # strand an orphan: a version dir no stable entry references
+        orphan_dir = os.path.join(index_path, "v__=9")
+        os.makedirs(orphan_dir)
+        orphan = os.path.join(orphan_dir, "part-orphan.parquet")
+        with open(orphan, "w") as f:
+            f.write("x")
+        assert recovery.find_orphans(index_path) == [orphan]
+        # a pinned snapshot naming the file blocks quarantine
+        entry = log_mgr.get_latest_stable_log().copy()
+        from hyperspace_tpu.metadata.entry import Content
+
+        entry.content = Content.from_leaf_files([(orphan, 1, 1)])
+        token = recovery.register_pins([entry])
+        rep = recovery.gc_orphans(index_path, grace_ms=0)
+        assert rep["kept_pinned"] == 1 and os.path.isfile(orphan)
+        recovery.release_pins(token)
+        # unpinned: quarantined but NOT purged inside the grace window
+        rep = recovery.gc_orphans(index_path, grace_ms=10 * 60_000)
+        assert rep["quarantined_dirs"] == 1
+        assert not os.path.exists(orphan)
+        qroot = os.path.join(index_path, C.HYPERSPACE_QUARANTINE_DIR)
+        assert os.path.isdir(qroot) and os.listdir(qroot)
+        assert rep["purged_stamps"] == 0
+        # grace elapsed: purged
+        rep = recovery.gc_orphans(
+            index_path, grace_ms=10 * 60_000,
+            now=recovery.now_ms() + 11 * 60_000,
+        )
+        assert rep["purged_stamps"] == 1
+        assert not os.path.exists(qroot)
+
+    def test_torn_entry_is_stranded_not_fatal(self, env):
+        s, hs, src, log_mgr = self._mk_index(env)
+        tip = log_mgr.get_latest_id() + 1
+        with open(log_mgr._path_for(tip), "w") as f:
+            f.write('{"state": "REFRESH')  # torn mid-write
+        with pytest.raises(LogCorruptedError):
+            log_mgr.get_log(tip)
+        # reads route around it...
+        assert log_mgr.get_latest_stable_log().state == States.ACTIVE
+        # ...and recovery rolls it back like any dead writer
+        rep = recovery.ensure_recovered(log_mgr, LEASE_MS)
+        assert rep["rolled_back"]
+        assert log_mgr.get_latest_log().state == States.ACTIVE
+
+    def test_torn_first_create_clears_to_doesnotexist(self, env, tmp_path):
+        s, hs, src = env
+        from hyperspace_tpu.metadata.log_manager import IndexLogManager
+
+        log_mgr = IndexLogManager(str(tmp_path / "fresh_idx"))
+        os.makedirs(log_mgr.log_dir)
+        with open(log_mgr._path_for(1), "w") as f:
+            f.write("{notjson")
+        rep = recovery.ensure_recovered(log_mgr, LEASE_MS)
+        assert rep["rolled_back"]
+        assert log_mgr.get_latest_id() is None  # name reusable
+
+    def test_recover_all_invalidates_entry_cache(self, env):
+        """A user-invoked recover_all() that rolls a log back must not
+        leave the TTL entry cache serving the pre-rollback snapshot."""
+        s, hs, src, log_mgr = self._mk_index(env)
+        s.index_manager.get_indexes()  # populate the TTL cache
+        stable = log_mgr.get_latest_stable_log()
+        stranded = stable.with_state(States.REFRESHING)
+        recovery.stamp_lease(stranded, "dead", 1)
+        assert log_mgr.write_log(log_mgr.get_latest_id() + 1, stranded)
+        wait_lease()
+        reports = s.index_manager.recover_all()
+        assert any(r["rolled_back"] for r in reports)
+        fresh = s.index_manager.get_indexes([States.ACTIVE])
+        assert [e.id for e in fresh] == [log_mgr.get_latest_id()]
+
+    def test_session_attach_sweeps_stranded_entries(
+        self, env, session_factory
+    ):
+        s, hs, src, log_mgr = self._mk_index(env)
+        stable = log_mgr.get_latest_stable_log()
+        stranded = stable.with_state(States.REFRESHING)
+        recovery.stamp_lease(stranded, "dead", 1)
+        assert log_mgr.write_log(log_mgr.get_latest_id() + 1, stranded)
+        wait_lease()
+        # a NEW session over the same system path repairs at attach
+        s2 = session_factory(1)
+        s2.conf.set(C.RECOVERY_LEASE_MS, LEASE_MS)
+        assert s2.index_manager is not None  # triggers attach sweep
+        assert log_mgr.get_latest_log().state == States.ACTIVE
+
+
+# ---------------------------------------------------------------------------
+# The crash matrix: every action x every applicable crash point
+# ---------------------------------------------------------------------------
+
+# action -> (applicable crash points, state after rollback of a
+# pre-commit crash, state after an after_end_log crash + pointer heal)
+MATRIX = {
+    "create": (
+        [
+            "after_begin_log",
+            "mid_data_write",
+            "after_data_write",
+            "after_end_log",
+        ],
+        States.DOESNOTEXIST,
+        States.ACTIVE,
+    ),
+    "refresh_full": (
+        [
+            "after_begin_log",
+            "mid_data_write",
+            "after_data_write",
+            "after_end_log",
+        ],
+        States.ACTIVE,
+        States.ACTIVE,
+    ),
+    "refresh_incremental": (
+        [
+            "after_begin_log",
+            "mid_data_write",
+            "after_data_write",
+            "after_end_log",
+        ],
+        States.ACTIVE,
+        States.ACTIVE,
+    ),
+    "refresh_quick": (
+        ["after_begin_log", "after_data_write", "after_end_log"],
+        States.ACTIVE,
+        States.ACTIVE,
+    ),
+    "optimize": (
+        [
+            "after_begin_log",
+            "mid_data_write",
+            "after_data_write",
+            "after_end_log",
+        ],
+        States.ACTIVE,
+        States.ACTIVE,
+    ),
+    "delete": (
+        ["after_begin_log", "after_data_write", "after_end_log"],
+        States.ACTIVE,
+        States.DELETED,
+    ),
+    "restore": (
+        ["after_begin_log", "after_data_write", "after_end_log"],
+        States.DELETED,
+        States.ACTIVE,
+    ),
+    "vacuum_deleted": (
+        [
+            "after_begin_log",
+            "mid_vacuum_delete",
+            "after_data_write",
+            "after_end_log",
+        ],
+        States.DELETED,
+        States.DOESNOTEXIST,
+    ),
+    "vacuum_outdated": (
+        [
+            "after_begin_log",
+            "mid_vacuum_delete",
+            "after_data_write",
+            "after_end_log",
+        ],
+        States.ACTIVE,
+        States.ACTIVE,
+    ),
+}
+
+CELLS = [
+    (action, point)
+    for action, (points, _r, _f) in MATRIX.items()
+    for point in points
+]
+
+
+class TestCrashMatrix:
+    def _setup(self, env, action):
+        """Build the action's precondition state; return its trigger."""
+        s, hs, src = env
+        df = s.read.parquet(src)
+        cfg = CoveringIndexConfig("idx", ["clicks"], ["query"])
+        if action != "create":
+            hs.create_index(df, cfg)
+        if action.startswith("refresh"):
+            append_file(src)
+        elif action == "optimize":
+            append_file(src, "e1")
+            hs.refresh_index("idx", "incremental")
+            append_file(src, "e2", clicks=(9101, 9102))
+            hs.refresh_index("idx", "incremental")
+        elif action in ("delete", "vacuum_outdated"):
+            if action == "vacuum_outdated":
+                append_file(src)
+                hs.refresh_index("idx", "full")  # old version to sweep
+        elif action in ("restore", "vacuum_deleted"):
+            hs.delete_index("idx")
+
+        def trigger():
+            {
+                "create": lambda: hs.create_index(
+                    s.read.parquet(src), cfg
+                ),
+                "refresh_full": lambda: hs.refresh_index("idx", "full"),
+                "refresh_incremental": lambda: hs.refresh_index(
+                    "idx", "incremental"
+                ),
+                "refresh_quick": lambda: hs.refresh_index("idx", "quick"),
+                "optimize": lambda: hs.optimize_index("idx", "full"),
+                "delete": lambda: hs.delete_index("idx"),
+                "restore": lambda: hs.restore_index("idx"),
+                "vacuum_deleted": lambda: hs.vacuum_index("idx"),
+                "vacuum_outdated": lambda: hs.vacuum_index("idx"),
+            }[action]()
+
+        return trigger
+
+    @pytest.mark.parametrize(("action", "point"), CELLS)
+    def test_crash_then_recover(self, env, action, point):
+        s, hs, src = env
+        trigger = self._setup(env, action)
+        points, rolled_state, committed_state = MATRIX[action]
+        log_mgr, _ = s.index_manager._managers("idx")
+        index_path = log_mgr.index_path
+        files_before = data_files(index_path)
+        faults.set_crash(point, "raise")
+        with pytest.raises(SimulatedCrash):
+            trigger()
+        assert faults.stats().get("crash." + point, 0) == 1
+        committed = point == "after_end_log"
+        if not committed:
+            # the writer died mid-protocol: transient tip on disk
+            assert log_mgr.get_latest_log().state not in States.STABLE_STATES
+        wait_lease()
+        rep = hs.recover("idx")
+        tip = log_mgr.get_latest_log()
+        if committed:
+            assert rep["healed_pointer"] and not rep["rolled_back"]
+            assert tip.state == committed_state
+            assert log_mgr.get_latest_stable_pointer_id() == tip.id
+        else:
+            assert rep["rolled_back"]
+            assert tip.state == rolled_state
+            # crash-free file-set equivalence: rollback + GC returns the
+            # data file set to exactly the pre-action state (vacuum may
+            # already have deleted files — a subset — before dying)
+            after = data_files(index_path)
+            if action.startswith("vacuum"):
+                assert after <= files_before
+            else:
+                assert after == files_before
+        # zero orphans, and a second GC pass is a no-op
+        assert recovery.find_orphans(index_path) == []
+        gc2 = recovery.gc_orphans(index_path, grace_ms=0)
+        assert gc2["quarantined_files"] == 0 and gc2["quarantined_dirs"] == 0
+        # serve truth is untouched either way
+        assert_serve_matches_source(s, src)
+        # the retried action completes (already-committed ops surface as
+        # no-op / illegal-state; both fine)
+        try:
+            trigger()
+        except HyperspaceException:
+            assert committed
+        assert (
+            log_mgr.get_latest_log().state in States.STABLE_STATES
+        )
+        assert_serve_matches_source(s, src)
+
+
+# ---------------------------------------------------------------------------
+# Cancel: direct coverage (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestCancelDirect:
+    @pytest.mark.parametrize(
+        "transient",
+        sorted(States.ROLLBACK),
+    )
+    def test_cancel_each_transient_state(self, env, transient):
+        s, hs, src = env
+        df = s.read.parquet(src)
+        hs.create_index(df, CoveringIndexConfig("idx", ["clicks"], ["query"]))
+        # move the stable base to what the transient state implies
+        expect = States.ROLLBACK[transient]
+        if expect == States.DELETED:
+            hs.delete_index("idx")
+        log_mgr, _ = s.index_manager._managers("idx")
+        stable = log_mgr.get_latest_stable_log()
+        stranded = stable.with_state(transient)
+        recovery.stamp_lease(stranded, "dead", 60_000)
+        assert log_mgr.write_log(log_mgr.get_latest_id() + 1, stranded)
+        s.index_manager.clear_cache()
+        # cancel is the OPERATOR override: it does not wait for the
+        # lease to expire
+        hs.cancel("idx")
+        tip = log_mgr.get_latest_log()
+        if expect == States.DOESNOTEXIST:
+            # cancel appends a copy of the LAST STABLE entry — for a
+            # stranded CREATING over an index with stable history that
+            # is the ACTIVE entry, not the ROLLBACK-map default (the
+            # no-history case is test_cancel_of_failed_first_create)
+            assert tip.state == States.ACTIVE
+        else:
+            assert tip.state == expect
+        assert recovery.LEASE_OWNER_PROP not in tip.properties
+
+    def test_cancel_of_failed_first_create(self, env):
+        s, hs, src = env
+        from hyperspace_tpu.actions import create as create_mod
+
+        def boom(self):
+            raise RuntimeError("op died")
+
+        orig = create_mod.CreateAction.op
+        create_mod.CreateAction.op = boom
+        try:
+            with pytest.raises(RuntimeError):
+                hs.create_index(
+                    s.read.parquet(src),
+                    CoveringIndexConfig("idx", ["clicks"]),
+                )
+        finally:
+            create_mod.CreateAction.op = orig
+        log_mgr, _ = s.index_manager._managers("idx")
+        assert log_mgr.get_latest_log().state == States.CREATING
+        hs.cancel("idx")
+        assert log_mgr.get_latest_log().state == States.DOESNOTEXIST
+        # name reusable right away
+        hs.create_index(
+            s.read.parquet(src), CoveringIndexConfig("idx", ["clicks"])
+        )
+
+    def test_cancel_losing_commit_race_raises(self, env, monkeypatch):
+        """When the live writer's end-commit wins the id cancel wanted,
+        cancel must NOT report success — the tip is stable, but it is
+        the opposite of a cancellation."""
+        s, hs, src = env
+        df = s.read.parquet(src)
+        hs.create_index(df, CoveringIndexConfig("idx", ["clicks"], ["query"]))
+        log_mgr, _ = s.index_manager._managers("idx")
+        stable = log_mgr.get_latest_stable_log()
+        tip = log_mgr.get_latest_id() + 1
+        busy = stable.with_state(States.REFRESHING)
+        recovery.stamp_lease(busy, "live", 60_000)
+        assert log_mgr.write_log(tip, busy)
+        from hyperspace_tpu.actions.cancel import CancelAction
+
+        real_write = log_mgr.write_log
+        committed = stable.copy()
+
+        def writer_sneaks_in(log_id, entry):
+            # the writer's end-commit lands just before cancel's write
+            if log_id == tip + 1 and not getattr(writer_sneaks_in, "done", 0):
+                writer_sneaks_in.done = 1
+                real_write(tip + 1, committed)
+            return real_write(log_id, entry)
+
+        monkeypatch.setattr(log_mgr, "write_log", writer_sneaks_in)
+        with pytest.raises(ConcurrentWriteException):
+            CancelAction(s, "idx", log_mgr).run()
+
+    def test_cancel_clears_torn_tip(self, env):
+        """cancel() is the manual override even with auto-recovery off:
+        a torn (truncated-JSON) tip must be cancellable, not wedge the
+        index behind a LogCorruptedError."""
+        s, hs, src = env
+        s.conf.set(C.RECOVERY_ENABLED, False)
+        df = s.read.parquet(src)
+        hs.create_index(df, CoveringIndexConfig("idx", ["clicks"], ["query"]))
+        log_mgr, _ = s.index_manager._managers("idx")
+        tip = log_mgr.get_latest_id() + 1
+        with open(log_mgr._path_for(tip), "w") as f:
+            f.write('{"state": "REFRESH')
+        hs.cancel("idx")
+        assert log_mgr.get_latest_log().state == States.ACTIVE
+
+    def test_cancel_racing_live_writer_lease(self, env, monkeypatch):
+        """Cancel vs a LIVE writer: cancel wins the rollback id, the
+        writer's end-commit loses the OCC race and aborts — never both."""
+        s, hs, src = env
+        df = s.read.parquet(src)
+        hs.create_index(df, CoveringIndexConfig("idx", ["clicks"], ["query"]))
+        append_file(src)
+        from hyperspace_tpu.actions import refresh as refresh_mod
+
+        in_op = threading.Event()
+        release = threading.Event()
+        orig_op = refresh_mod.RefreshAction.op
+
+        def gated_op(self):
+            in_op.set()
+            assert release.wait(10)
+            return orig_op(self)
+
+        monkeypatch.setattr(refresh_mod.RefreshAction, "op", gated_op)
+        errors = []
+
+        def run_refresh():
+            try:
+                hs.refresh_index("idx", "full")
+            except Exception as exc:
+                errors.append(exc)
+
+        t = threading.Thread(target=run_refresh)
+        t.start()
+        assert in_op.wait(10)
+        log_mgr, _ = s.index_manager._managers("idx")
+        live = log_mgr.get_latest_log()
+        assert live.state == States.REFRESHING
+        assert not recovery.is_stranded(live, 60_000)  # lease is live
+        hs.cancel("idx")  # operator override
+        release.set()
+        t.join(30)
+        assert len(errors) == 1
+        assert isinstance(errors[0], ConcurrentWriteException)
+        assert log_mgr.get_latest_log().state == States.ACTIVE
+
+
+# ---------------------------------------------------------------------------
+# base_id TOCTOU (satellite): snapshot at run(), not __init__
+# ---------------------------------------------------------------------------
+
+
+class TestBaseIdResnapshot:
+    def test_queued_action_does_not_clobber(self, env):
+        s, hs, src = env
+        df = s.read.parquet(src)
+        hs.create_index(df, CoveringIndexConfig("idx", ["clicks"], ["query"]))
+        log_mgr, _ = s.index_manager._managers("idx")
+        from hyperspace_tpu.actions.delete import DeleteAction
+
+        queued = DeleteAction(s, "idx", log_mgr)
+        stale_base = queued.base_id
+        # the log advances while the action sits in a queue
+        append_file(src)
+        hs.refresh_index("idx", "full")
+        assert log_mgr.get_latest_id() == stale_base + 2
+        queued.run()  # must re-snapshot, not write at stale_base + 1
+        assert queued.base_id == stale_base + 2
+        tip = log_mgr.get_latest_log()
+        assert tip.state == States.DELETED
+        assert tip.id == stale_base + 4
+
+    def test_occ_loser_retries_from_fresh_snapshot(self, env, monkeypatch):
+        """An action whose begin write collides retries against the new
+        tip instead of surfacing ConcurrentWriteException."""
+        s, hs, src = env
+        df = s.read.parquet(src)
+        hs.create_index(df, CoveringIndexConfig("idx", ["clicks"], ["query"]))
+        log_mgr, _ = s.index_manager._managers("idx")
+        from hyperspace_tpu.actions.delete import DeleteAction, RestoreAction
+
+        # simulate the interleaving: another writer's FULL delete lands
+        # between our snapshot and our begin write, exactly once
+        real_write = log_mgr.write_log
+        fired = {}
+
+        def racing_write(log_id, entry):
+            if not fired:
+                fired["x"] = True
+                DeleteAction(s, "idx", log_mgr).run()  # rival wins first
+            return real_write(log_id, entry)
+
+        monkeypatch.setattr(log_mgr, "write_log", racing_write)
+        action = DeleteAction(s, "idx", log_mgr)
+        with pytest.raises(HyperspaceException, match="requires state"):
+            # retry DOES re-validate: the rival delete moved the index
+            # to DELETED, so our delete is now illegal — typed, precise
+            action.run()
+        monkeypatch.undo()
+        # and an action still legal after the race simply succeeds
+        restore = RestoreAction(s, "idx", log_mgr)
+        restore.run()
+        assert log_mgr.get_latest_log().state == States.ACTIVE
+
+
+# ---------------------------------------------------------------------------
+# Subprocess (true torn state): the process REALLY dies mid-protocol
+# ---------------------------------------------------------------------------
+
+
+CHILD_TEMPLATE = """
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+from hyperspace_tpu.session import HyperspaceSession
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.testing import faults
+
+s = HyperspaceSession()
+s.conf.set(C.INDEX_SYSTEM_PATH, {index_root!r})
+s.conf.set(C.INDEX_NUM_BUCKETS, 8)
+s.conf.set(C.RECOVERY_LEASE_MS, {lease!r})
+hs = Hyperspace(s)
+faults.set_crash({point!r}, "exit")
+{body}
+raise SystemExit(7)  # must never get here: the crash point exits first
+"""
+
+
+@pytest.mark.slow
+class TestSubprocessCrash:
+    def _run_child(self, body, index_root, point):
+        code = CHILD_TEMPLATE.format(
+            index_root=index_root, point=point, lease=LEASE_MS, body=body
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=300,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        assert proc.returncode == faults.CRASH_EXIT_CODE, (
+            proc.returncode,
+            proc.stdout[-2000:],
+            proc.stderr[-2000:],
+        )
+
+    @pytest.mark.parametrize("point", ["mid_data_write", "after_begin_log"])
+    def test_child_create_killed_then_recovered(self, env, tmp_path, point):
+        s, hs, src = env
+        index_root = s.conf.get(C.INDEX_SYSTEM_PATH)
+        body = (
+            f"df = s.read.parquet({src!r})\n"
+            "hs.create_index(df, CoveringIndexConfig('idx', ['clicks'], "
+            "['query']))"
+        )
+        self._run_child(body, index_root, point)
+        log_mgr, _ = s.index_manager._managers("idx")
+        assert log_mgr.get_latest_log().state == States.CREATING
+        wait_lease()
+        rep = hs.recover("idx")
+        assert rep["rolled_back"]
+        assert log_mgr.get_latest_log().state == States.DOESNOTEXIST
+        assert recovery.find_orphans(log_mgr.index_path) == []
+        # name reusable: the retried create completes in THIS process
+        hs.create_index(
+            s.read.parquet(src),
+            CoveringIndexConfig("idx", ["clicks"], ["query"]),
+        )
+        assert_serve_matches_source(s, src)
+
+    def test_child_refresh_killed_after_end_log(self, env):
+        s, hs, src = env
+        df = s.read.parquet(src)
+        hs.create_index(df, CoveringIndexConfig("idx", ["clicks"], ["query"]))
+        append_file(src)
+        index_root = s.conf.get(C.INDEX_SYSTEM_PATH)
+        body = "hs.refresh_index('idx', 'full')"
+        self._run_child(body, index_root, "after_end_log")
+        log_mgr, _ = s.index_manager._managers("idx")
+        tip_id = log_mgr.get_latest_id()
+        # committed but unpublished: the pointer lags the tip
+        assert log_mgr.get_latest_stable_pointer_id() != tip_id
+        rep = hs.recover("idx")
+        assert rep["healed_pointer"]
+        assert log_mgr.get_latest_stable_pointer_id() == tip_id
+        assert recovery.find_orphans(log_mgr.index_path) == []
+        assert_serve_matches_source(s, src)

@@ -352,6 +352,15 @@ class TestLogStateMachine:
         }
         assert "HS205" in _rules(_lint(tmp_path, files))
 
+    def test_rollback_edge_to_unstable_state(self, tmp_path):
+        # seeded broken recovery edge: DELETING rolls back to CREATING
+        # (transient) — cancel()/crash recovery would strand differently
+        constants = CONSTANTS.replace(
+            "DELETING: ACTIVE,", "DELETING: CREATING,"
+        )
+        files = {"constants.py": constants, "actions/act.py": ACTIONS_CLEAN}
+        assert "HS206" in _rules(_lint(tmp_path, files))
+
     def test_suppression(self, tmp_path):
         files = {
             "constants.py": CONSTANTS,
@@ -1059,6 +1068,30 @@ class TestContracts:
         ]
         assert len(findings) == 1 and "b_point" in findings[0].message
 
+    def test_crash_matrix_hole(self, tmp_path):
+        # crash points have their own matrix file: a point missing from
+        # tests/test_crash_recovery.py is an untested crash mode
+        _write_doc(tmp_path)
+        files = {
+            "constants.py": CONTRACT_CONSTANTS + "    BAR_DEFAULT = 3\n",
+            "config.py": CONTRACT_CONFIG,
+            "testing/faults.py": (
+                'POINTS = ("a_point",)\n'
+                'CRASH_POINTS = ("after_x", "mid_y")\n'
+            ),
+        }
+        tests = {
+            "test_faults.py": "def test_matrix():\n    assert 'a_point'\n",
+            "test_crash_recovery.py": (
+                "def test_crash():\n    assert 'after_x'\n"
+            ),
+        }
+        findings = [
+            f for f in _lint(tmp_path, files, tests=tests) if f.rule == "HS703"
+        ]
+        assert len(findings) == 1 and "mid_y" in findings[0].message
+        assert "test_crash_recovery.py" in findings[0].message
+
     def test_clean_and_prefix_family(self, tmp_path):
         _write_doc(
             tmp_path,
@@ -1203,6 +1236,7 @@ class TestGolden:
         "HS203",
         "HS204",
         "HS205",
+        "HS206",
         "HS301",
         "HS302",
         "HS401",

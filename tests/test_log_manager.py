@@ -121,3 +121,56 @@ def test_concurrent_writers_exactly_one_wins(tmp_path):
     assert len(winners) == 1, results
     stored = IndexLogManager(path).get_log(1)
     assert stored.name == f"writer-{winners[0]}"
+
+
+# ---------------------------------------------------------------------------
+# Torn-entry handling (PR 10 satellite): typed LogCorruptedError, reads
+# route around corruption, publish is dirent-durable
+# ---------------------------------------------------------------------------
+
+
+def test_torn_entry_raises_typed_error(tmp_path):
+    from hyperspace_tpu.exceptions import LogCorruptedError
+
+    mgr = IndexLogManager(str(tmp_path / "idx"))
+    assert mgr.write_log(0, make_entry(state=States.ACTIVE))
+    with open(mgr._path_for(1), "w") as f:
+        f.write('{"state": "REFRESH')  # truncated mid-write
+    try:
+        mgr.get_log(1)
+        assert False, "expected LogCorruptedError"
+    except LogCorruptedError as exc:
+        assert "1" in exc.path and exc.reason
+
+
+def test_stable_scan_and_versions_skip_torn_entries(tmp_path):
+    mgr = IndexLogManager(str(tmp_path / "idx"))
+    assert mgr.write_log(0, make_entry(state=States.CREATING))
+    assert mgr.write_log(1, make_entry(state=States.ACTIVE))
+    with open(mgr._path_for(2), "w") as f:
+        f.write("not json at all")
+    found = mgr.get_latest_stable_log()
+    assert found is not None and found.id == 1
+    assert mgr.get_index_versions([States.ACTIVE]) == [1]
+
+
+def test_torn_pointer_falls_back_to_scan(tmp_path):
+    mgr = IndexLogManager(str(tmp_path / "idx"))
+    assert mgr.write_log(0, make_entry(state=States.ACTIVE))
+    assert mgr.create_latest_stable_log(0)
+    with open(mgr._latest_stable_path, "w") as f:
+        f.write('{"truncat')
+    assert mgr.get_latest_stable_pointer_id() is None
+    found = mgr.get_latest_stable_log()
+    assert found is not None and found.id == 0
+
+
+def test_overwrite_log_replaces_in_place(tmp_path):
+    mgr = IndexLogManager(str(tmp_path / "idx"))
+    entry = make_entry(state=States.REFRESHING)
+    assert mgr.write_log(3, entry)
+    entry.properties["recovery.leaseExpiresAtMs"] = "12345"
+    mgr.overwrite_log(3, entry)
+    got = mgr.get_log(3)
+    assert got.properties["recovery.leaseExpiresAtMs"] == "12345"
+    assert got.id == 3
