@@ -139,6 +139,13 @@ SHARED_STATE: Dict[str, Tuple[str, str, str]] = {
         "builds a fresh dict and publishes it with one atomic rebind, "
         "readers copy the reference they grabbed",
     ),
+    "hyperspace_tpu.parallel.shuffle._skew_warned": (
+        "",
+        "rebind-only",
+        "once-per-build skew-warning latch: plain bool rebinds "
+        "(False at data-op entry, True at first warn); a racy "
+        "check-then-warn can only duplicate one log line",
+    ),
     "hyperspace_tpu.indexes.zonemaps.last_prune_stats": (
         "",
         "rebind-only",

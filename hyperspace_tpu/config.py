@@ -114,6 +114,25 @@ class Config:
         return self.get_int(C.BUILD_NUM_SHARDS, C.BUILD_NUM_SHARDS_DEFAULT)
 
     @property
+    def build_exchange_strategy(self) -> str:
+        """Exchange strategy of the build's bucket shuffle
+        (``parallel/shuffle.py``): ``auto`` | ``flat`` | ``compact`` |
+        ``host`` | ``twostage`` — all bit-identical; ``auto`` resolves
+        per topology (see ``shuffle.resolve_strategy``)."""
+        return self.get_str(
+            C.BUILD_EXCHANGE_STRATEGY, C.BUILD_EXCHANGE_STRATEGY_DEFAULT
+        )
+
+    @property
+    def build_exchange_twostage_hosts(self) -> int:
+        """Simulated host count for the twostage exchange on a
+        single-process mesh (0 = derive from the process count)."""
+        return self.get_int(
+            C.BUILD_EXCHANGE_TWOSTAGE_HOSTS,
+            C.BUILD_EXCHANGE_TWOSTAGE_HOSTS_DEFAULT,
+        )
+
+    @property
     def build_sharded_tail(self) -> bool:
         """Device-local build/serve tail on a >1-device mesh: per-shard
         sort + write and per-shard join prepare/merge, union at the

@@ -139,6 +139,25 @@ INDEX_BUILD_PARTITION_FIRST_DEFAULT = True
 BUILD_SHARDED_TAIL_ENABLED = "hyperspace.build.shardedTail.enabled"
 BUILD_SHARDED_TAIL_ENABLED_DEFAULT = True
 
+# Exchange-strategy plane (parallel/shuffle.py, docs/MULTIHOST.md): the
+# build's bucket shuffle is a library of pluggable strategies behind one
+# interface — "auto" resolves per topology (multi-process job ->
+# "twostage" DCN/ICI decomposition; CPU mesh -> "host" pure-RAM reorder,
+# the simulation must never pay ICI-emulation costs; single-host
+# accelerator -> "compact" when the calibration probe measured it
+# beating "flat" at the build size, else "flat", the padded-[D, cap]
+# all_to_all baseline). Every strategy is differential-tested
+# bit-identical to "flat".
+BUILD_EXCHANGE_STRATEGY = "hyperspace.build.exchange.strategy"
+BUILD_EXCHANGE_STRATEGY_DEFAULT = "auto"
+
+# Simulated host count for the twostage strategy on a SINGLE-process
+# mesh (tests / A-B runs carve the flat mesh into this many groups of
+# contiguous devices); 0 = derive from jax.process_count(). A real
+# multi-process job always uses the process count.
+BUILD_EXCHANGE_TWOSTAGE_HOSTS = "hyperspace.build.exchange.twostageHosts"
+BUILD_EXCHANGE_TWOSTAGE_HOSTS_DEFAULT = 0
+
 # Warn when the bucket shuffle's per-(shard, peer) send-count skew
 # (max/mean) exceeds this: the exchange pads every slot to the max
 # count, so one hot bucket silently inflates exchange memory by ~skew×.

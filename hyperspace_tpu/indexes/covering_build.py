@@ -40,7 +40,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 import pyarrow as pa
 
-from hyperspace_tpu.constants import DATA_FILE_NAME_ID, LINEAGE_PROPERTY
+from hyperspace_tpu.constants import (
+    DATA_FILE_NAME_ID,
+    INDEX_FILE_PREFIX as C_INDEX_FILE_PREFIX,
+    LINEAGE_PROPERTY,
+)
 from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.indexes.base import UpdateMode
 from hyperspace_tpu.io import parquet as pio
@@ -102,6 +106,63 @@ class SourceScan:
     # time — lets refresh's delete compensation stream previous index
     # data instead of materializing it whole
     excluded_lineage_ids: Optional[Tuple[int, ...]] = None
+
+    def process_local(self) -> "SourceScan":
+        """This process's file subset (``files[p::P]``) — the multi-host
+        build feed (docs/MULTIHOST.md): each host scans, hashes and
+        exchanges only its own rows (the exchange moves them straight to
+        their owner host via ``make_array_from_process_local_data``, no
+        round-trip through process 0). Global row order becomes
+        process-major; identity on a single-process job."""
+        import jax
+
+        nproc = jax.process_count()
+        if nproc <= 1:
+            return self
+        p = jax.process_index()
+        return dataclasses.replace(
+            self,
+            files=self.files[p::nproc],
+            file_sizes=(
+                self.file_sizes[p::nproc]
+                if self.file_sizes is not None
+                else None
+            ),
+        )
+
+    def empty_batch(self) -> ColumnarBatch:
+        """Zero-row batch with this scan's exact output structure — the
+        stripe a process contributes when a wave (or the whole job) has
+        no files for it. Parquet-family sources read only the first
+        file's footer schema (no row reads); anything else falls back to
+        materializing one file and slicing it to zero rows."""
+        if self.fmt in ("parquet", "delta", "iceberg"):
+            try:
+                import pyarrow.parquet as pq
+
+                t = pq.read_schema(self.files[0]).empty_table()
+                b = ColumnarBatch.from_arrow(t.select(list(self.columns)))
+                if self.file_ids is not None:
+                    b = b.with_column(
+                        DATA_FILE_NAME_ID,
+                        Column(
+                            "numeric",
+                            pa.int64(),
+                            values=np.zeros(0, dtype=np.int64),
+                        ),
+                    )
+                if self.select_cols is not None:
+                    b = b.select(list(self.select_cols))
+                return b
+            except (
+                OSError,
+                KeyError,
+                pa.ArrowInvalid,
+                pa.ArrowNotImplementedError,
+            ):  # nested/exotic schema or unreadable footer: pay the row read
+                pass
+        b = self.materialize(list(self.files[:1]))
+        return b.filter(np.zeros(b.num_rows, dtype=bool))
 
     def materialize(self, files: Optional[Sequence[str]] = None) -> ColumnarBatch:
         batch = _scan_with_lineage(
@@ -184,6 +245,13 @@ class CompositeScan:
         if not parts:
             raise HyperspaceException("No files to materialize")
         return ColumnarBatch.concat(parts)
+
+    def process_local(self) -> "CompositeScan":
+        return CompositeScan(tuple(s.process_local() for s in self.scans))
+
+    def empty_batch(self) -> ColumnarBatch:
+        # all parts select the same output columns (class contract)
+        return self.scans[0].empty_batch()
 
     def select(self, cols: Sequence[str]) -> "CompositeScan":
         return CompositeScan(tuple(s.select(cols) for s in self.scans))
@@ -347,11 +415,13 @@ def prepare_covering_index(ctx, source_df, config, properties: Dict[str, str]):
 last_build_breakdown: Dict[str, float] = {}
 _build_bd_lock = _threading.Lock()
 
-# Non-timing telemetry of the most recent build: the shuffle's exchange
-# capacity and per-(shard, peer) skew ratio (``parallel/shuffle.
-# last_shuffle_stats``), copied here per data op so the bench and
-# operators read one coherent snapshot.
-last_build_telemetry: Dict[str, float] = {}
+# Non-timing telemetry of the most recent build: the exchange plane's
+# snapshot (``parallel/shuffle.last_shuffle_stats`` — chosen strategy,
+# pack/exchange/unpack seconds, capacity, per-(shard, peer) skew),
+# folded in per exchange by ``_record_shuffle_telemetry`` (stage seconds
+# summed across waves, skew carried as max/mean + wave count) so the
+# bench and operators read one coherent snapshot.
+last_build_telemetry: Dict[str, object] = {}
 
 
 def _stage_add(name: str, t0: float) -> None:
@@ -365,7 +435,13 @@ def reset_build_breakdown() -> None:
     prepare_covering_index; refresh/optimize call it directly) so the
     breakdown never mixes two ops' stage times. Takes the breakdown
     lock: a reset must never interleave with a sharded-tail worker's
-    ``_stage_add`` read-modify-write (HS602, SHARED_STATE)."""
+    ``_stage_add`` read-modify-write (HS602, SHARED_STATE). Also rearms
+    the shuffle's once-per-build skew warning (the streaming build runs
+    one exchange per wave; the warning fires at most once per op while
+    telemetry records every wave)."""
+    from hyperspace_tpu.parallel import shuffle as _shuffle
+
+    _shuffle.reset_skew_warning()
     with _build_bd_lock:
         last_build_breakdown.clear()
         last_build_telemetry.clear()
@@ -375,12 +451,21 @@ def lazy_or_materialized(ctx, scan):
     """THE build memory-budget rule, in one place: keep the scan lazy
     (streamed at write time through the wave loop) when its estimated
     materialized size exceeds ``hyperspace.index.build.memoryBudgetBytes``,
-    else materialize now. Accepts SourceScan or CompositeScan."""
+    else materialize now. Accepts SourceScan or CompositeScan. On a
+    multi-process job each process materializes only its own file subset
+    (``process_local``) — the exchange routes rows to their owner host."""
     budget = ctx.session.conf.build_memory_budget
     if budget and scan.estimated_bytes() > budget:
         return scan
     t0 = _time.perf_counter()
-    out = scan.materialize()
+    local = scan.process_local()
+    if local.files:
+        out = local.materialize()
+    else:
+        # more hosts than files: this process contributes zero rows but
+        # must still know the schema (and later join every exchange
+        # collective) — a zero-row batch from the footer schema
+        out = scan.empty_batch()
     _stage_add("scan", t0)
     return out
 
@@ -476,30 +561,63 @@ def _hash_shuffle(
     is the ``[D+1]`` per-shard row extent of the exchanged batch (rows
     ``offsets[s]:offsets[s+1]`` hold exactly the buckets shard ``s``
     owns), or None when no exchange ran (single device / tiny batch)."""
+    import jax
+
     t0 = _time.perf_counter()
     reps = batch.key_reps(indexed_cols)
     mesh = ctx.mesh
     shard_offs = None
-    if mesh.devices.size > 1 and batch.num_rows >= mesh.devices.size:
+    # multi-process: ALWAYS exchange, even a zero/tiny local batch — the
+    # exchange is a collective and every process must take the same
+    # number of steps (a peer may be feeding this wave real rows)
+    if mesh.devices.size > 1 and (
+        batch.num_rows >= mesh.devices.size or jax.process_count() > 1
+    ):
         from hyperspace_tpu.parallel import shuffle as _shuffle
 
         arrays, spec = _decompose(batch)
         k = reps.shape[0]
+        conf = ctx.session.conf
         buckets, moved, shard_offs = _shuffle.bucket_shuffle(
             mesh, reps, list(reps) + arrays, num_buckets,
             with_shard_offsets=True,
+            strategy=conf.build_exchange_strategy,
+            twostage_hosts=conf.build_exchange_twostage_hosts,
         )
         reps = np.stack(moved[:k]) if k else np.zeros((0, len(buckets)))
         batch = _reassemble(spec, moved[k:])
-        with _build_bd_lock:
-            last_build_telemetry.update(
-                ("shuffle_" + k2, v)
-                for k2, v in _shuffle.last_shuffle_stats.items()
-            )
+        _record_shuffle_telemetry(_shuffle.last_shuffle_stats)
     else:
         buckets = bucket_ids_np(reps, num_buckets)
     _stage_add("hash_shuffle", t0)
     return buckets, reps, batch, shard_offs
+
+
+def _record_shuffle_telemetry(stats: Dict) -> None:
+    """Fold one exchange's snapshot into the build telemetry: latest
+    value for every ``shuffle_<key>``, pack/exchange/unpack seconds
+    SUMMED across waves, and the per-wave skew carried as a max/mean
+    pair plus the wave count (a streaming build runs one exchange per
+    wave; a single hot wave must stay visible in the max while the mean
+    says whether it was the rule or the exception)."""
+    with _build_bd_lock:
+        t = last_build_telemetry
+        waves = t.get("shuffle_waves", 0.0) + 1.0
+        for k, v in stats.items():
+            key = "shuffle_" + k
+            if k in ("pack_s", "exchange_s", "unpack_s"):
+                t[key] = round(t.get(key, 0.0) + float(v), 4)
+            else:
+                t[key] = v
+        skew = float(stats.get("skew_ratio", 1.0))
+        prev_mean = t.get("shuffle_skew_ratio_mean", 0.0)
+        t["shuffle_waves"] = waves
+        t["shuffle_skew_ratio_max"] = max(
+            t.get("shuffle_skew_ratio_max", 0.0), skew
+        )
+        t["shuffle_skew_ratio_mean"] = round(
+            prev_mean + (skew - prev_mean) / waves, 3
+        )
 
 
 def _partition_first(ctx) -> bool:
@@ -579,17 +697,27 @@ def write_bucketed(
 
     sources = data if isinstance(data, list) else [data]
     if any(isinstance(s, SourceScan) for s in sources):
-        return _write_bucketed_streaming(
-            ctx, sources, indexed_cols, num_buckets, file_idx_offset
+        return _global_written(
+            ctx,
+            _write_bucketed_streaming(
+                ctx, sources, indexed_cols, num_buckets, file_idx_offset
+            ),
         )
     batch = sources[0] if len(sources) == 1 else ColumnarBatch.concat(sources)
-    if batch.num_rows == 0:
+    if batch.num_rows == 0 and _single_process():
+        # multi-process never takes this shortcut: a zero-row LOCAL
+        # batch still owes its peers the exchange collectives and the
+        # _global_written barrier (its devices may RECEIVE rows)
         os.makedirs(ctx.index_data_path, exist_ok=True)
         return []
     use_dict = pio.dictionary_columns_for_batch(batch)
     if _partition_first(ctx):
-        return _write_bucketed_pipelined(
-            ctx, batch, indexed_cols, num_buckets, file_idx_offset, use_dict
+        return _global_written(
+            ctx,
+            _write_bucketed_pipelined(
+                ctx, batch, indexed_cols, num_buckets, file_idx_offset,
+                use_dict,
+            ),
         )
     buckets, batch = bucketize(ctx, batch, indexed_cols, num_buckets)
     t0 = _time.perf_counter()
@@ -602,7 +730,37 @@ def write_bucketed(
         use_dictionary=use_dict,
     )
     _stage_add("write", t0)
-    return out
+    return _global_written(ctx, out)
+
+
+def _single_process() -> bool:
+    import jax
+
+    return jax.process_count() <= 1
+
+
+def _global_written(ctx, written: List[str]) -> List[str]:
+    """The written-file list a build hands to the metadata plane. On a
+    single-process job this is the writer's own list; on a multi-process
+    job every host wrote only the buckets its shards own, so after a
+    cross-host barrier the (deterministically named, bucket-id-ordered)
+    union is listed from the data dir — every process returns the same
+    global list for the coordinator's log entry."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return written
+    import os
+
+    from jax.experimental import multihost_utils as mhu
+
+    mhu.sync_global_devices("hs_build_bucketed_write")
+    d = ctx.index_data_path
+    return [
+        os.path.join(d, f)
+        for f in sorted(os.listdir(d))
+        if f.startswith(C_INDEX_FILE_PREFIX) and f.endswith(".parquet")
+    ]
 
 
 def _write_bucketed_pipelined(
@@ -809,12 +967,22 @@ def _write_bucketed_streaming(
     import shutil
 
     budget = ctx.session.conf.build_memory_budget or (1 << 62)
+    import jax
+
+    nproc = jax.process_count()
     # outside the v__=N data dir (also a key=value name) but inside the
     # index dir; the leading underscore keeps it out of data listings and
-    # the sanitized name keeps "=" out of every spill path component
+    # the sanitized name keeps "=" out of every spill path component.
+    # Multi-process: the index dir is a SHARED filesystem and each
+    # process spills + merges only its own owned buckets, so the spill
+    # dir is per-process — a peer finishing early must never rmtree
+    # parts another process is still merging
+    suffix = f"-p{jax.process_index()}" if nproc > 1 else ""
     spill_root = os.path.join(
         os.path.dirname(ctx.index_data_path),
-        "_spill_" + os.path.basename(ctx.index_data_path).replace("=", "_"),
+        "_spill_"
+        + os.path.basename(ctx.index_data_path).replace("=", "_")
+        + suffix,
     )
     os.makedirs(spill_root, exist_ok=True)
     wave_idx = 0
@@ -822,14 +990,36 @@ def _write_bucketed_streaming(
     try:
         for src in sources:
             if isinstance(src, SourceScan):
+                # waves are planned over the GLOBAL file list on every
+                # process (the SPMD requirement: identical wave count =
+                # identical number of per-wave exchange collectives);
+                # multi-process, each host materializes only its stripe
+                # of a wave — an empty stripe still joins the wave's
+                # exchange with a zero-row, schema-correct slice
                 waves = plan_waves(
                     src.files, src.fmt, budget, src.file_sizes
                 )
-                wave_batches = (src.materialize(w) for w in waves)
+                if nproc > 1:
+                    index_of = {f: i for i, f in enumerate(src.files)}
+                    pid = jax.process_index()
+
+                    def stripes(src=src, waves=waves, index_of=index_of):
+                        for w in waves:
+                            mine = [
+                                f for f in w if index_of[f] % nproc == pid
+                            ]
+                            if mine:
+                                yield src.materialize(mine)
+                            else:
+                                yield src.empty_batch()
+
+                    wave_batches = stripes()
+                else:
+                    wave_batches = (src.materialize(w) for w in waves)
             else:
                 wave_batches = iter([src])
             for batch in wave_batches:
-                if batch.num_rows == 0:
+                if batch.num_rows == 0 and nproc == 1:
                     continue
                 buckets, batch = bucketize(
                     ctx, batch, indexed_cols, num_buckets
@@ -910,8 +1100,30 @@ def rewrite_files(
     ctx, files_to_optimize: List[str], indexed_cols: List[str], num_buckets: int
 ) -> List[str]:
     """Optimize: read the listed index files and rewrite them compacted
-    (CoveringIndexTrait.optimize:130-134 — 'read files → write')."""
-    batch = ColumnarBatch.from_arrow(pio.read_table(files_to_optimize, None))
+    (CoveringIndexTrait.optimize:130-134 — 'read files → write'). On a
+    multi-process job each host reads a disjoint subset; the exchange
+    routes rows back to their owner host before the write."""
+    import jax
+
+    # a data op like create/refresh: fresh stage breakdown, telemetry
+    # accumulators and skew-warn latch (the exchange stats now SUM
+    # across waves — without the reset they would mix two ops)
+    reset_build_breakdown()
+    nproc = jax.process_count()
+    subset = files_to_optimize
+    if nproc > 1:
+        subset = files_to_optimize[jax.process_index()::nproc]
+    if subset:
+        batch = ColumnarBatch.from_arrow(pio.read_table(subset, None))
+    else:
+        # more hosts than files: still owe peers the exchange
+        # collectives + write barrier — a zero-row batch from the first
+        # index file's footer schema (index files are always parquet)
+        import pyarrow.parquet as pq
+
+        batch = ColumnarBatch.from_arrow(
+            pq.read_schema(files_to_optimize[0]).empty_table()
+        )
     return write_bucketed(ctx, batch, indexed_cols, num_buckets)
 
 

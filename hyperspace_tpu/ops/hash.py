@@ -238,7 +238,13 @@ def bucket_ids_pallas(words, num_buckets: int, seed: int = 42):
     # BlockSpec index maps produce i64 grid indices, which this Mosaic
     # rejects ("failed to legalize 'func.return'" on (i64, i32)); the
     # kernel itself is pure uint32/int32
-    with jax.enable_x64(False):
+    try:
+        x64_off = jax.enable_x64(False)
+    except AttributeError:  # older jax: the experimental spelling
+        from jax.experimental import enable_x64 as _enable_x64
+
+        x64_off = _enable_x64(False)
+    with x64_off:
         out = pl.pallas_call(
             kernel,
             grid=grid,

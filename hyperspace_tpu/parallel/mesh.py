@@ -58,7 +58,20 @@ def initialize_distributed(
             f"none of them (auto-detected TPU pod); got {explicit}"
         )
     if cpu_local_devices is not None:
-        jax.config.update("jax_num_cpu_devices", int(cpu_local_devices))
+        try:
+            jax.config.update("jax_num_cpu_devices", int(cpu_local_devices))
+        except AttributeError:
+            # older jax: the option predates jax_num_cpu_devices — fall
+            # back to the XLA flag, honored as long as no backend has
+            # been initialized yet (this function's contract: call
+            # before any session / device use)
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags
+                    + " --xla_force_host_platform_device_count="
+                    + str(int(cpu_local_devices))
+                ).strip()
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
     kwargs = {}
     if coordinator_address is not None:

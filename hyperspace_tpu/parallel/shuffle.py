@@ -1,30 +1,67 @@
-"""Bucketing shuffle: ``shard_map`` + ``lax.all_to_all`` over the mesh.
+"""Exchange-strategy plane: pluggable bucketing shuffles over the mesh.
 
-TPU-native replacement for the Spark hash-partition shuffle at the heart of
-the covering-index build (reference:
-``index/covering/CoveringIndex.scala:58-61`` ``repartition(numBuckets,
-indexedCols)`` and the Hybrid-Scan on-the-fly shuffle,
-``covering/CoveringIndexRuleUtils.scala:357-417``).
+TPU-native replacement for the Spark hash-partition shuffle at the heart
+of the covering-index build (reference: ``index/covering/CoveringIndex.
+scala:58-61`` ``repartition(numBuckets, indexedCols)``), rebuilt as a
+*library of exchange strategies* behind one interface — the Exoshuffle
+doctrine (PAPERS.md): shuffle belongs to the application as composable
+strategies, not one engine-baked implementation. The strategy is chosen
+per build by ``hyperspace.build.exchange.strategy`` (default ``auto``:
+per-machine/topology resolution, see :func:`resolve_strategy`):
 
-Each device hashes its local rows to buckets (``ops/hash.py``), routes rows
-to the device that owns the bucket (``bucket % D``), and exchanges them in
-ONE ``all_to_all`` over the ICI ring. Since XLA programs need static
-shapes, each device sends a ``[D, cap]`` buffer plus a validity mask, where
-``cap`` is the power-of-two-padded MAX per-(shard, peer) count computed on
-the host before dispatch — exchange memory tracks real traffic (~n_local
-for a balanced hash) instead of the worst-case ``D x n_local``; the host
-compacts valid rows after the exchange.
-(For >HBM datasets the same exchange runs once per wave over chunked host
-batches — the reference leans on Spark's disk-backed shuffle for this;
-our wave loop is ``indexes/covering_build._write_bucketed_streaming``,
-driven by ``hyperspace.index.build.memoryBudgetBytes``.)
+``flat``
+    The original single ``lax.all_to_all`` over the flat shard axis:
+    every device scatters rows into a padded ``[D, cap]`` buffer (cap =
+    power-of-two-padded max per-(shard, peer) count) and sorts the
+    received rows by bucket on device. The baseline every other strategy
+    is differential-tested against, and the default on a single-host
+    accelerator mesh.
+``compact``
+    Host-packed variable-length exchange: the host bucket ids computed
+    for capacity planning drive an exact-extent pack on the host (slot
+    per (source, peer) pair, cap = exact max count — no power-of-two
+    blowup), the device program is ONE ``all_to_all`` per payload with
+    no on-device hashing, scatter or argsort, and the host unpacks via
+    the closed-form receive position of every row. Moves only the
+    payload bytes (no bucket/validity planes).
+``host``
+    No device round-trip at all: rows are reordered in host RAM with the
+    canonical post-exchange permutation (threaded native/numpy gathers).
+    The CPU-simulation default — an emulated ICI exchange on a CPU mesh
+    pays real pack/argsort/copy costs to move rows between host buffers
+    that live in the same RAM (39s of the 51s 64M/mesh8 build,
+    MULTICHIP_r06) — and the per-host leg of a multi-host decomposition.
+``twostage``
+    The DCN/ICI decomposition from docs/MULTIHOST.md: the intra-host leg
+    runs host-side (each host re-groups its rows in RAM by destination
+    lane), and the cross-host leg is one ``ppermute`` round per peer
+    host over the ``dcn`` mesh axis with **per-peer slot caps** sized
+    from the per-(shard, peer) count matrix — the skew telemetry from
+    the ``[D, cap]`` era becomes the slot-sizing input instead of only a
+    warning (one hot destination host inflates only the rounds that
+    target it, not every slot).
+
+Every strategy produces BIT-IDENTICAL output to ``flat``: the flat
+program's post-exchange order is exactly the valid rows stable-sorted by
+``(bucket % D, bucket)`` with ties in original row order (received rows
+concatenate source-major per peer, sources hold local row order, and the
+final per-shard sort is a stable sort by bucket), so
+:func:`canonical_order` reproduces it host-side from the bucket ids
+alone. ``tests/test_exchange_strategies.py`` makes that argument
+mechanical across mesh sizes, payload types and skews.
+
+(For >HBM datasets the same exchange runs once per wave over chunked
+host batches — the wave loop is ``indexes/covering_build.
+_write_bucketed_streaming``, driven by
+``hyperspace.index.build.memoryBudgetBytes``.)
 """
 
 from __future__ import annotations
 
 import functools
 import logging
-from typing import Dict, List, Sequence, Tuple
+import time as _time
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -34,30 +71,226 @@ from jax.sharding import PartitionSpec as P
 
 _log = logging.getLogger("hyperspace_tpu.shuffle")
 
-# Telemetry of the most recent ``bucket_shuffle`` (host-observed, set by
-# ``_exchange_cap``): exchange capacity and the per-(shard, peer)
-# send-count skew. The exchange pads every (shard, peer) slot to the MAX
-# count, so one hot bucket inflates exchange memory by ~skew× silently —
-# the build copies this into its telemetry and the bench publishes it.
+# Telemetry of the most recent ``bucket_shuffle`` (host-observed):
+# strategy name, pack/exchange/unpack stage seconds, exchange capacity
+# and the per-(shard, peer) send-count skew. The padded-buffer
+# strategies size slots from the MAX count, so one hot bucket inflates
+# exchange memory by ~skew× silently — the build copies this into its
+# telemetry (accumulating per-wave skew as max/mean) and the bench
+# publishes it.
 last_shuffle_stats: Dict[str, float] = {}
 
-from hyperspace_tpu.ops.hash import hash_columns
-from hyperspace_tpu.parallel.mesh import SHARD_AXIS
+# Once-per-build latch for the shuffle-skew warning: the streaming build
+# runs one exchange per wave and the same skew would otherwise log every
+# wave. ``covering_build.reset_build_breakdown`` rearms it at each data
+# op via :func:`reset_skew_warning`; telemetry records the ratio for
+# every wave regardless.
+_skew_warned: bool = False
+
+from hyperspace_tpu.ops.hash import bucket_ids_host
+from hyperspace_tpu.ops.sort import partition_by_bucket
+from hyperspace_tpu.parallel.mesh import DCN_AXIS, ICI_AXIS, SHARD_AXIS
 
 try:  # jax >= 0.6 exposes shard_map at top level
     shard_map = jax.shard_map
 except AttributeError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
+STRATEGY_AUTO = "auto"
+STRATEGY_FLAT = "flat"
+STRATEGY_COMPACT = "compact"
+STRATEGY_HOST = "host"
+STRATEGY_TWOSTAGE = "twostage"
+STRATEGIES = (
+    STRATEGY_FLAT,
+    STRATEGY_COMPACT,
+    STRATEGY_HOST,
+    STRATEGY_TWOSTAGE,
+)
+
+
+def reset_skew_warning() -> None:
+    """Rearm the once-per-build skew warning (called by
+    ``covering_build.reset_build_breakdown`` at every data-op entry)."""
+    global _skew_warned
+    _skew_warned = False
+
+
+# ---------------------------------------------------------------------------
+# Shared host-side planning: bucket ids, counts, canonical order
+# ---------------------------------------------------------------------------
+
+
+def _host_bucket_ids(
+    key_reps: np.ndarray, num_buckets: int, seed: int, chunk: int = 1 << 18
+) -> np.ndarray:
+    """Chunked host murmur3 bucket ids — bit-identical to the device
+    hash (``ops/hash.py`` twins) and computed ONCE per exchange: every
+    strategy reuses these ids for capacity planning, packing and
+    ordering instead of re-hashing on device (the old flat program
+    hashed every row a second time)."""
+    n = key_reps.shape[1]
+    out = np.empty(n, dtype=np.int32)
+    for start in range(0, n, chunk):
+        end = min(start + chunk, n)
+        out[start:end] = bucket_ids_host(
+            key_reps[:, start:end], num_buckets, seed
+        )
+    return out
+
+
+def _peer_counts(
+    owner: np.ndarray, valid: Optional[np.ndarray], n_local: int, D: int
+) -> np.ndarray:
+    """``[D, D]`` count of valid rows each source shard (contiguous
+    ``n_local``-row blocks) sends to each owner shard — the slot-sizing
+    and skew-telemetry input of every padded strategy."""
+    src = (np.arange(len(owner)) // n_local).astype(np.int64)
+    if valid is not None:
+        src, owner = src[valid], owner[valid]
+    return np.bincount(src * D + owner, minlength=D * D).reshape(D, D)
+
+
+def _publish_stats(
+    strategy: str, D: int, cap: int, counts: np.ndarray, extra: Dict
+) -> None:
+    """Build the telemetry snapshot + once-per-build skew warning.
+
+    Publishes as ONE atomic rebind, never clear()+update(): a concurrent
+    build copying the snapshot (covering_build telemetry) must see a
+    whole dict, old or new — never the empty window between the two
+    mutations (SHARED_STATE policy: rebind-only)."""
+    from hyperspace_tpu.constants import (
+        BUILD_SHUFFLE_SKEW_WARN_MIN_ROWS,
+        BUILD_SHUFFLE_SKEW_WARN_RATIO,
+    )
+
+    max_count = int(counts.max()) if counts.size else 0
+    mean_count = float(counts.mean()) if counts.size else 0.0
+    skew = max_count / mean_count if mean_count > 0 else 1.0
+    stats: Dict = {
+        "strategy": strategy,
+        "devices": float(D),
+        "cap": float(cap),
+        "max_peer_count": float(max_count),
+        "mean_peer_count": round(mean_count, 1),
+        "skew_ratio": round(skew, 2),
+    }
+    stats.update(extra)
+    global last_shuffle_stats, _skew_warned
+    last_shuffle_stats = stats
+    if (
+        skew > BUILD_SHUFFLE_SKEW_WARN_RATIO
+        and max_count >= BUILD_SHUFFLE_SKEW_WARN_MIN_ROWS
+        and not _skew_warned
+    ):
+        _skew_warned = True
+        _log.warning(
+            "bucket shuffle skew: hottest (shard, peer) slot carries "
+            "%.1fx the mean row count (max=%d, mean=%.0f, D=%d, "
+            "strategy=%s) — padded exchange slots inflate accordingly; "
+            "consider more buckets or less skewed key columns "
+            "(warned once per build; telemetry records every wave)",
+            skew,
+            max_count,
+            mean_count,
+            D,
+            strategy,
+        )
+
+
+def canonical_order(
+    bucket_ids: np.ndarray, num_buckets: int, D: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """THE post-exchange row order, host-side: a stable permutation
+    sorting rows by ``(owner = bucket % D, bucket)`` (ties keep original
+    row order), plus the ``[D+1]`` per-owner-shard row extents.
+
+    This reproduces the flat ``all_to_all`` output exactly: shard ``s``
+    holds the buckets it owns in ascending bucket order, and within a
+    bucket the received rows concatenate source-shard-major with each
+    source's rows in local (= original) order — i.e. ascending original
+    row index. Computed as a counting scatter over owner-major-remapped
+    bucket ids (native ``hs_partition_by_bucket`` above its dispatch
+    threshold), O(n)."""
+    b = np.arange(num_buckets, dtype=np.int64)
+    owner_rank = np.lexsort((b, b % D))  # buckets in (owner, bucket) order
+    remap = np.empty(num_buckets, dtype=np.int32)
+    remap[owner_rank] = np.arange(num_buckets, dtype=np.int32)
+    order, offsets = partition_by_bucket(remap[bucket_ids], num_buckets)
+    per_bucket = np.diff(offsets)
+    per_owner = np.bincount(
+        owner_rank % D, weights=per_bucket, minlength=D
+    ).astype(np.int64)
+    shard_offsets = np.concatenate(
+        [np.zeros(1, dtype=np.int64), np.cumsum(per_owner)]
+    )
+    return order, shard_offsets
+
+
+def _shape_cap(exact: int) -> int:
+    """Slot capacity rounded up to 3 significant bits (next multiple of
+    ``2^(floor(log2 n) - 2)``): a streaming build's waves have slightly
+    different max peer counts, and an EXACT cap would re-trace the
+    exchange program once per wave. Three significant bits bound the
+    padding at <25% (vs up to 2x for the flat path's power-of-two cap)
+    while keeping the number of distinct compile shapes per octave at 4.
+    Correctness never depends on it — the unpack reads exact per-peer
+    extents from the count matrix either way."""
+    exact = max(int(exact), 1)
+    if exact <= 8:
+        return exact
+    step = 1 << (exact.bit_length() - 3)
+    return -(-exact // step) * step
+
+
+def _pair_ranks(slot_ids: np.ndarray, num_slots: int) -> np.ndarray:
+    """Rank of each row within its (source, destination) slot, in
+    original row order — the within-slot position the host pack and the
+    closed-form receive positions share."""
+    order, offsets = partition_by_bucket(slot_ids, num_slots)
+    within = np.arange(len(order), dtype=np.int64) - np.repeat(
+        offsets[:-1], np.diff(offsets)
+    )
+    rank = np.empty(len(order), dtype=np.int64)
+    rank[order] = within
+    return rank
+
+
+def _threaded_gather(
+    arrays: Sequence[np.ndarray], idx: np.ndarray
+) -> List[np.ndarray]:
+    """``[a[idx] for a in arrays]`` with per-column threading: 8-byte
+    dtypes ride the threaded native gather (``hs_gather_*``, releases
+    the GIL), the rest plain numpy. The "threaded numpy slicing" leg of
+    the host-side exchange."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from hyperspace_tpu import native
+    from hyperspace_tpu.io.columnar import _gather
+
+    workers = min(len(arrays), max(1, min(native._cores(), 8)))
+    if workers <= 1 or len(idx) < (1 << 16):
+        return [_gather(a, idx) for a in arrays]
+    with ThreadPoolExecutor(
+        max_workers=workers, thread_name_prefix="hs-exchange"
+    ) as pool:
+        return list(pool.map(lambda a: _gather(a, idx), arrays))
+
+
+# ---------------------------------------------------------------------------
+# Strategy: flat all_to_all (the baseline)
+# ---------------------------------------------------------------------------
+
 
 @functools.partial(
-    jax.jit, static_argnames=("mesh", "num_buckets", "num_payload", "seed", "cap")
+    jax.jit, static_argnames=("mesh", "num_buckets", "num_payload", "cap")
 )
-def _shuffle_program(
-    mesh, key_reps, valid, payloads, num_buckets, num_payload, seed, cap
-):
-    """The compiled multi-chip shuffle. Shapes: key_reps [k, N], valid [N],
-    payloads tuple of [N]-arrays; N divisible by D = mesh size.
+def _flat_program(mesh, bucket_host, valid, payloads, num_buckets, num_payload, cap):
+    """The compiled flat multi-chip shuffle. Shapes: bucket_host [N]
+    int32 (HOST-computed ids — the device no longer re-hashes; the host
+    twin is bit-exact and already computed for capacity planning), valid
+    [N], payloads tuple of [N]-arrays; N divisible by D = mesh size.
 
     ``cap`` is the per-(shard, peer) send capacity, computed on the host
     from the actual destination counts and padded to a power of two. The
@@ -67,15 +300,12 @@ def _shuffle_program(
     del num_payload  # encoded in payloads pytree structure
     D = mesh.devices.size
 
-    def local(reps, vld, cols):
-        n = reps.shape[1]
-        bucket = (hash_columns(reps, seed) % jnp.uint32(num_buckets)).astype(
-            jnp.int32
-        )
+    def local(bkt, vld, cols):
+        n = bkt.shape[0]
         # invalid (padding) rows route to sentinel destination D: they
         # never occupy exchange slots, so cap tracks VALID traffic only
-        # (host counts valid rows only; see _exchange_cap)
-        dest = jnp.where(vld, bucket % D, jnp.int32(D))
+        # (host counts valid rows only)
+        dest = jnp.where(vld, bkt % D, jnp.int32(D))
         order = jnp.argsort(dest, stable=True)
         dest_s = dest[order]
         counts = jnp.bincount(dest_s, length=D + 1)
@@ -93,11 +323,11 @@ def _shuffle_program(
             return buf.at[dest_s, rank].set(col[order])
 
         exchange = lambda x: lax.all_to_all(x, SHARD_AXIS, 0, 0, tiled=True)
-        recv_bucket = exchange(scatter(bucket))
+        recv_bucket = exchange(scatter(bkt))
         recv_valid = exchange(scatter(vld.astype(jnp.bool_), fill=False))
         recv_cols = tuple(exchange(scatter(c)) for c in cols)
         # Flatten the per-peer dimension; sort locally by (valid desc,
-        # bucket, keys) so each bucket is one contiguous run and invalid
+        # bucket) so each bucket is one contiguous run and invalid
         # slots sink to the tail.
         flat_bucket = recv_bucket.reshape(-1)
         flat_valid = recv_valid.reshape(-1)
@@ -113,38 +343,35 @@ def _shuffle_program(
     return shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(None, SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
         out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
-    )(key_reps, valid, payloads)
+    )(bucket_host, valid, payloads)
 
 
-def bucket_shuffle(
-    mesh,
-    key_reps: np.ndarray,
-    payloads: Sequence[np.ndarray],
-    num_buckets: int,
-    seed: int = 42,
-    with_shard_offsets: bool = False,
-):
-    """Host entry: shuffle rows into bucket-contiguous order across the mesh.
+def _process_local_operand(hmesh, local_block: np.ndarray):
+    """This process's ``[1, L, B]`` send block -> the globally-sharded
+    ``[H, L, B]`` device operand, built via
+    ``make_array_from_process_local_data`` so the feed never round-trips
+    through process 0 (docs/MULTIHOST.md; exercised by
+    ``scripts/dryrun_multihost.py``)."""
+    from jax.sharding import NamedSharding
 
-    Returns ``(bucket_ids, payload_cols)`` with all rows grouped by bucket
-    (global order: all rows of buckets owned by shard 0, then shard 1, …;
-    within a shard, ascending bucket id). The caller does the final
-    within-bucket key sort (``ops/sort.py``) before writing.
+    return jax.make_array_from_process_local_data(
+        NamedSharding(hmesh, P(DCN_AXIS, ICI_AXIS)),
+        np.ascontiguousarray(local_block),
+    )
 
-    ``with_shard_offsets=True`` additionally returns the ``[D+1]`` row
-    offsets of each shard's compacted slice — rows
-    ``offsets[s]:offsets[s+1]`` are exactly the buckets shard ``s`` owns
-    (``bucket % D == s``), the handle the sharded build/serve tail needs
-    to keep bucket ownership device-local past the exchange.
-    """
+
+def _flat_exchange(mesh, key_reps, payloads, num_buckets, seed):
+    """Strategy ``flat`` — the original padded-[D, cap] all_to_all path,
+    kept as the baseline (and single-host accelerator default)."""
     from hyperspace_tpu.ops import pad_len
 
     D = mesh.devices.size
     n = key_reps.shape[1]
-    # power-of-two row count (ops/__init__ shape policy), then round up to
-    # a multiple of D so shard_map divides evenly
+    t0 = _time.perf_counter()
+    # power-of-two row count (ops/__init__ shape policy), then round up
+    # to a multiple of D so shard_map divides evenly
     target = pad_len(n)
     target += (-target) % D
     pad = target - n
@@ -154,35 +381,68 @@ def bucket_shuffle(
     valid = np.ones(n + pad, dtype=bool)
     if pad:
         valid[n:] = False
-    cap = _exchange_cap(key_reps, valid, num_buckets, D, seed)
-    bucket, vmask, cols = _shuffle_program(
+    bucket_host = _host_bucket_ids(key_reps, num_buckets, seed)
+    cap, counts = _flat_cap(bucket_host, valid, D)
+    pack_s = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    bucket, vmask, cols = _flat_program(
         mesh,
-        jnp.asarray(key_reps),
+        jnp.asarray(bucket_host),
         jnp.asarray(valid),
         tuple(jnp.asarray(p) for p in payloads),
         num_buckets,
         len(payloads),
-        seed,
         cap,
     )
     bucket = np.asarray(bucket)
     vmask = np.asarray(vmask)
+    exchange_s = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
     keep = np.nonzero(vmask)[0]
     if len(keep) != n:
         raise RuntimeError(
             f"bucket shuffle lost rows: sent {n}, received {len(keep)} "
             f"(cap={cap}) — host/device hash divergence?"
         )
-    out = bucket[keep], [np.asarray(c)[keep] for c in cols]
-    if not with_shard_offsets:
-        return out
-    # shard s's post-exchange slice is rows [s*D*cap, (s+1)*D*cap) of the
-    # flat output; its compacted extent is the valid count per slice
+    out_bucket = bucket[keep]
+    out_cols = [np.asarray(c)[keep] for c in cols]
+    # shard s's post-exchange slice is rows [s*D*cap, (s+1)*D*cap) of
+    # the flat output; its compacted extent is the valid count per slice
     per_shard = vmask.reshape(D, D * cap).sum(axis=1)
     offsets = np.concatenate(
         [np.zeros(1, dtype=np.int64), np.cumsum(per_shard, dtype=np.int64)]
     )
-    return out[0], out[1], offsets
+    unpack_s = _time.perf_counter() - t0
+    _publish_stats(
+        STRATEGY_FLAT,
+        D,
+        cap,
+        counts,
+        _timing(pack_s, exchange_s, unpack_s),
+    )
+    return out_bucket, out_cols, offsets
+
+
+def _flat_cap(
+    bucket_host: np.ndarray, valid: np.ndarray, D: int
+) -> Tuple[int, np.ndarray]:
+    """(cap, counts) for the flat program: the power-of-two-padded MAX
+    count of VALID rows any shard sends to any peer, never larger than a
+    shard's slice."""
+    from hyperspace_tpu.ops import pad_len
+
+    n_local = len(bucket_host) // D
+    counts = _peer_counts(bucket_host % D, valid, n_local, D)
+    max_count = max(int(counts.max()), 1)
+    return min(pad_len(max_count), n_local), counts
+
+
+def _timing(pack_s: float, exchange_s: float, unpack_s: float) -> Dict:
+    return {
+        "pack_s": round(pack_s, 4),
+        "exchange_s": round(exchange_s, 4),
+        "unpack_s": round(unpack_s, 4),
+    }
 
 
 def _exchange_cap(
@@ -193,58 +453,489 @@ def _exchange_cap(
     seed: int,
     chunk: int = 1 << 18,
 ) -> int:
-    """Per-(shard, peer) exchange capacity: the power-of-two-padded MAX
-    count of VALID rows any shard sends to any peer. Host-only (chunked
-    numpy murmur3, bit-identical to the device hash — never dispatches
-    the unsharded array to one device) and pad rows are excluded (the
-    program routes them to a sentinel destination)."""
-    from hyperspace_tpu.ops import pad_len
-    from hyperspace_tpu.ops.hash import bucket_ids_host
+    """Back-compat capacity probe (tests): per-(shard, peer) exchange
+    capacity of the flat strategy for an already-padded input, also
+    publishing the skew telemetry snapshot."""
+    ids = _host_bucket_ids(key_reps, num_buckets, seed, chunk)
+    cap, counts = _flat_cap(ids, valid, D)
+    _publish_stats(STRATEGY_FLAT, D, cap, counts, {})
+    return cap
 
-    from hyperspace_tpu.constants import (
-        BUILD_SHUFFLE_SKEW_WARN_MIN_ROWS,
-        BUILD_SHUFFLE_SKEW_WARN_RATIO,
+
+# ---------------------------------------------------------------------------
+# Strategy: host-side exchange (no device round trip)
+# ---------------------------------------------------------------------------
+
+
+def _host_exchange(mesh, key_reps, payloads, num_buckets, seed):
+    """Strategy ``host`` — the exchange as a pure host reorder.
+
+    On a CPU mesh the "exchange" moves rows between buffers that live in
+    the same RAM; emulating ICI (pad, scatter, collective, device
+    argsorts, host↔device copies) is pure overhead. The canonical
+    permutation is computed once from the host bucket ids and applied
+    with threaded native/numpy gathers. Also the per-host leg of a
+    multi-host decomposition (each host regrouping its local rows)."""
+    D = mesh.devices.size
+    n = key_reps.shape[1]
+    t0 = _time.perf_counter()
+    bucket_ids = _host_bucket_ids(key_reps, num_buckets, seed)
+    n_local = -(-n // D) if n else 1
+    counts = _peer_counts(bucket_ids % D, None, n_local, D)
+    perm, shard_offsets = canonical_order(bucket_ids, num_buckets, D)
+    pack_s = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    out_cols = _threaded_gather(payloads, perm)
+    out_bucket = bucket_ids[perm]
+    exchange_s = _time.perf_counter() - t0
+    _publish_stats(
+        STRATEGY_HOST,
+        D,
+        int(counts.max()) if counts.size else 0,
+        counts,
+        _timing(pack_s, exchange_s, 0.0),
+    )
+    return out_bucket, out_cols, shard_offsets
+
+
+# ---------------------------------------------------------------------------
+# Strategy: compact variable-length exchange
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("mesh",))
+def _compact_program(mesh, payloads):
+    """ONE tiled all_to_all per payload — no on-device hashing, scatter
+    or argsort; the host packed exact (source, peer) extents and unpacks
+    by closed-form receive positions."""
+
+    def local(cols):
+        return tuple(
+            lax.all_to_all(c, SHARD_AXIS, 0, 0, tiled=True) for c in cols
+        )
+
+    return shard_map(
+        local, mesh=mesh, in_specs=(P(SHARD_AXIS),), out_specs=P(SHARD_AXIS)
+    )(payloads)
+
+
+def _compact_exchange(mesh, key_reps, payloads, num_buckets, seed):
+    """Strategy ``compact`` — host-packed exact-extent device exchange.
+
+    The host bucket ids drive a counting-scatter pack into ``[D*D,
+    cap]`` send buffers (slot per (source, peer) pair, cap = the exact
+    max count — not power-of-two padded), each payload rides one
+    ``all_to_all``, and the unpack gathers each row from its closed-form
+    receive position ``(owner*D + source)*cap + rank`` straight into
+    canonical order. Compared to ``flat`` this drops the second hash
+    pass, both device argsorts, the bucket/validity planes from the
+    wire, and the pow2 cap blowup; the exchanged bytes are exactly
+    ``D*D*cap`` slots per payload."""
+    D = mesh.devices.size
+    n = key_reps.shape[1]
+    t0 = _time.perf_counter()
+    bucket_ids = _host_bucket_ids(key_reps, num_buckets, seed)
+    owner = bucket_ids % D
+    n_local = -(-n // D) if n else 1
+    src = (np.arange(n, dtype=np.int64) // n_local).astype(np.int64)
+    counts = _peer_counts(owner, None, n_local, D)
+    cap = _shape_cap(counts.max())
+    slot = (src * D + owner).astype(np.int32)
+    rank = _pair_ranks(slot, D * D)
+    send_pos = slot.astype(np.int64) * cap + rank
+    recv_pos = (owner.astype(np.int64) * D + src) * cap + rank
+    sends = []
+    for p in payloads:
+        buf = np.zeros(D * D * cap, dtype=p.dtype)
+        buf[send_pos] = p
+        sends.append(buf.reshape(D * D, cap))
+    pack_s = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    out = _compact_program(
+        mesh,
+        tuple(jnp.asarray(s) for s in sends),
+    )
+    flats = [np.asarray(o).reshape(-1) for o in out]
+    exchange_s = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    out_perm, shard_offsets = canonical_order(bucket_ids, num_buckets, D)
+    gather_idx = recv_pos[out_perm]
+    out_cols = _threaded_gather(flats, gather_idx)
+    out_bucket = bucket_ids[out_perm]
+    unpack_s = _time.perf_counter() - t0
+    _publish_stats(
+        STRATEGY_COMPACT,
+        D,
+        cap,
+        counts,
+        _timing(pack_s, exchange_s, unpack_s),
+    )
+    return out_bucket, out_cols, shard_offsets
+
+
+# ---------------------------------------------------------------------------
+# Strategy: two-stage DCN/ICI decomposition
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("hmesh", "caps"))
+def _twostage_program(hmesh, payloads, caps):
+    """The cross-host leg: one ``ppermute`` per peer host over the
+    ``dcn`` axis, each round's slot sized to ITS (source host, peer
+    host) max — skew-aware per-peer caps, not one global max. The
+    intra-host leg already ran host-side (rows were packed into their
+    destination ici lane's buffer), so no ``ici`` collective is needed
+    — lane l's buffer lands on device (dest_host, l) directly."""
+    H = hmesh.shape[DCN_AXIS]
+    offs = [0]  # static slice offsets from the static per-round caps
+    for c in caps:
+        offs.append(offs[-1] + c)
+
+    def local(cols):
+        def route(x):
+            b = x.reshape(-1)
+            parts = [b[offs[0] : offs[1]]]  # round 0: rows staying on-host
+            for r in range(1, H):
+                seg = b[offs[r] : offs[r + 1]]
+                parts.append(
+                    lax.ppermute(
+                        seg,
+                        DCN_AXIS,
+                        [(h, (h + r) % H) for h in range(H)],
+                    )
+                )
+            return jnp.concatenate(parts).reshape(x.shape)
+
+        return tuple(route(c) for c in cols)
+
+    return shard_map(
+        local,
+        mesh=hmesh,
+        in_specs=(P(DCN_AXIS, ICI_AXIS),),
+        out_specs=P(DCN_AXIS, ICI_AXIS),
+    )(payloads)
+
+
+def hierarchical_view(mesh, hosts: int):
+    """(H, L) (dcn, ici) mesh over the SAME devices as the flat build
+    mesh — process-major device order makes row h the h-th host's
+    devices on a real multi-host job; on a single-controller simulation
+    ``hosts`` carves the flat mesh into simulated hosts."""
+    D = mesh.devices.size
+    if D % hosts:
+        raise ValueError(
+            f"twostage exchange: {hosts} hosts do not divide the "
+            f"{D}-device mesh"
+        )
+    return jax.sharding.Mesh(
+        mesh.devices.reshape(hosts, D // hosts), (DCN_AXIS, ICI_AXIS)
     )
 
-    total = key_reps.shape[1]
-    n_local = total // D
-    counts = np.zeros((D, D), dtype=np.int64)
-    for start in range(0, total, chunk):
-        end = min(start + chunk, total)
-        dest = bucket_ids_host(key_reps[:, start:end], num_buckets, seed) % D
-        shard = np.arange(start, end) // n_local
-        v = valid[start:end]
-        np.add.at(counts, (shard[v], dest[v]), 1)
-    max_count = max(int(counts.max()), 1)
-    cap = min(pad_len(max_count), n_local)  # never larger than a shard
-    # skew telemetry: the [D, cap] exchange buffers pad every slot to the
-    # hottest (shard, peer) count, so memory = skew × the balanced cost
-    mean_count = float(counts.mean())
-    skew = max_count / mean_count if mean_count > 0 else 1.0
-    # publish as ONE atomic rebind, never clear()+update(): a concurrent
-    # build copying the snapshot (covering_build telemetry) must see a
-    # whole dict, old or new — never the empty window between the two
-    # mutations (SHARED_STATE policy: rebind-only)
-    global last_shuffle_stats
-    last_shuffle_stats = {
-        "devices": float(D),
-        "cap": float(cap),
-        "max_peer_count": float(max_count),
-        "mean_peer_count": round(mean_count, 1),
-        "skew_ratio": round(skew, 2),
-    }
-    if (
-        skew > BUILD_SHUFFLE_SKEW_WARN_RATIO
-        and max_count >= BUILD_SHUFFLE_SKEW_WARN_MIN_ROWS
-    ):
-        _log.warning(
-            "bucket shuffle skew: hottest (shard, peer) slot carries "
-            "%.1fx the mean row count (max=%d, mean=%.0f, D=%d) — the "
-            "padded exchange buffers inflate accordingly; consider more "
-            "buckets or less skewed key columns",
-            skew,
-            max_count,
-            mean_count,
-            D,
+
+def _twostage_exchange_mp(mesh, key_reps, payloads, num_buckets, seed):
+    """The REAL multi-host leg of the twostage strategy: every process
+    passes only ITS rows (the per-host scan feed — global row order is
+    process-major) and receives back only the rows of the buckets its
+    local devices own, in canonical order, plus ``[D+1]`` shard extents
+    in which non-local shards are empty.
+
+    Same slot layout as the single-controller simulation, built
+    per-process: the host-side ici leg packs local rows into their
+    destination lane's buffer, caps come from a ``process_allgather`` of
+    the per-(host, lane) count matrix (every process must compile the
+    same SPMD shapes), the send block feeds the global array via
+    ``make_array_from_process_local_data`` (no round-trip through
+    process 0), bucket ids ride as one extra int32 payload (the receiver
+    cannot re-derive them without re-hashing), and the local unpack
+    stable-sorts each lane's received rows by (bucket, source host,
+    slot rank) — exactly the canonical (bucket, global row) order.
+    Exercised cross-process by ``scripts/dryrun_multihost.py``."""
+    from jax.experimental import multihost_utils as mhu
+
+    H = jax.process_count()
+    pid = jax.process_index()
+    D = mesh.devices.size
+    L = D // H
+    n = key_reps.shape[1]
+    t0 = _time.perf_counter()
+    bucket_ids = _host_bucket_ids(key_reps, num_buckets, seed)
+    owner = bucket_ids % D
+    dst_h = owner // L
+    lane = owner % L
+    rnd = (dst_h - pid) % H
+    hl_local = np.bincount(dst_h * L + lane, minlength=H * L).reshape(H, L)
+    hl_all = np.asarray(mhu.process_allgather(hl_local))  # [H, H, L]
+    caps = tuple(
+        _shape_cap(hl_all[np.arange(H), (np.arange(H) + r) % H, :].max())
+        for r in range(H)
+    )
+    offs = np.concatenate([[0], np.cumsum(caps)]).astype(np.int64)
+    B = int(offs[-1])
+    rank = _pair_ranks(owner.astype(np.int32), D)
+    send_pos = lane * B + offs[rnd] + rank
+    sends = []
+    for p in [bucket_ids] + list(payloads):
+        buf = np.zeros(L * B, dtype=p.dtype)
+        buf[send_pos] = p
+        sends.append(buf.reshape(1, L, B))
+    pack_s = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    hmesh = hierarchical_view(mesh, H)
+    out = _twostage_program(
+        hmesh, tuple(_process_local_operand(hmesh, s) for s in sends), caps
+    )
+    local = []
+    for arr in out:
+        shards = sorted(arr.addressable_shards, key=lambda s: s.index)
+        local.append(
+            np.concatenate(
+                [np.asarray(s.data).reshape(-1) for s in shards]
+            ).reshape(L, B)
         )
-    return cap
+    exchange_s = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    recv_ids, recv_cols = local[0], local[1:]
+    # valid extents per (lane, round) from the global count matrix; round
+    # r of lane l carries hl_all[(pid - r) % H, pid, l] rows — reorder
+    # rounds by SOURCE HOST so concatenation follows global row order
+    out_bucket_parts: List[np.ndarray] = []
+    out_col_parts: List[List[np.ndarray]] = [[] for _ in recv_cols]
+    per_shard = np.zeros(D, dtype=np.int64)
+    for l in range(L):
+        ids_parts, col_parts = [], [[] for _ in recv_cols]
+        for src_h in range(H):
+            r = (pid - src_h) % H
+            cnt = int(hl_all[src_h, pid, l])
+            lo = int(offs[r])
+            ids_parts.append(recv_ids[l, lo : lo + cnt])
+            for i, c in enumerate(recv_cols):
+                col_parts[i].append(c[l, lo : lo + cnt])
+        ids_l = np.concatenate(ids_parts)
+        order = np.argsort(ids_l, kind="stable")
+        out_bucket_parts.append(ids_l[order])
+        for i in range(len(recv_cols)):
+            out_col_parts[i].append(np.concatenate(col_parts[i])[order])
+        per_shard[pid * L + l] = len(ids_l)
+    out_bucket = (
+        np.concatenate(out_bucket_parts)
+        if out_bucket_parts
+        else np.zeros(0, dtype=np.int32)
+    )
+    out_cols = [
+        np.concatenate(parts)
+        if parts
+        else np.zeros(0, dtype=c.dtype)
+        for parts, c in zip(out_col_parts, recv_cols)
+    ]
+    shard_offsets = np.concatenate(
+        [np.zeros(1, dtype=np.int64), np.cumsum(per_shard)]
+    )
+    expect = int(hl_all[:, pid, :].sum())
+    if len(out_bucket) != expect:
+        raise RuntimeError(
+            f"multi-host bucket shuffle lost rows on process {pid}: "
+            f"expected {expect}, received {len(out_bucket)}"
+        )
+    unpack_s = _time.perf_counter() - t0
+    _publish_stats(
+        STRATEGY_TWOSTAGE,
+        D,
+        int(max(caps)),
+        hl_all[pid],  # this process's per-(peer host, lane) send counts
+        {
+            "hosts": float(H),
+            "process_local": 1.0,
+            "round_cap_max": float(max(caps)),
+            "round_cap_min": float(min(caps)),
+            **_timing(pack_s, exchange_s, unpack_s),
+        },
+    )
+    return out_bucket, out_cols, shard_offsets
+
+
+def _twostage_exchange(mesh, key_reps, payloads, num_buckets, seed, hosts):
+    """Strategy ``twostage`` — docs/MULTIHOST.md's DCN/ICI decomposition.
+
+    Intra-host leg on the host: each host's rows are packed (in RAM) into
+    per-(peer-host, destination-lane) slots, aggregating its L devices'
+    sends into one buffer per peer host. Cross-host leg on the device:
+    H-1 ``ppermute`` rounds over ``dcn``, round r's slot sized to
+    ``max(count[src_host → (src_host+r) % H host, lane])`` — the
+    per-(shard, peer) count matrix (the skew telemetry) IS the slot
+    sizing, so a hot destination host inflates only the rounds that
+    target it. Row volume over DCN is unchanged vs flat; message count
+    per host drops to one buffer per peer host and no row pays a second
+    device hash or argsort.
+
+    On a REAL multi-process job the per-process variant runs instead
+    (:func:`_twostage_exchange_mp`): per-host inputs, per-host outputs,
+    ``make_array_from_process_local_data`` feed. The single-controller
+    body below simulates the same decomposition by carving the flat mesh
+    into ``hosts`` groups of contiguous devices."""
+    if jax.process_count() > 1:
+        return _twostage_exchange_mp(mesh, key_reps, payloads, num_buckets, seed)
+    D = mesh.devices.size
+    H = int(hosts) if hosts and hosts > 0 else max(jax.process_count(), 1)
+    H = min(H, D)
+    while D % H:
+        H -= 1
+    L = D // H
+    n = key_reps.shape[1]
+    t0 = _time.perf_counter()
+    bucket_ids = _host_bucket_ids(key_reps, num_buckets, seed)
+    owner = bucket_ids % D
+    n_local = -(-n // D) if n else 1
+    counts = _peer_counts(owner, None, n_local, D)
+    src_dev = (np.arange(n, dtype=np.int64) // n_local).astype(np.int64)
+    src_h = src_dev // L
+    dst_h = owner // L
+    lane = owner % L
+    rnd = (dst_h - src_h) % H
+    # per-round slot caps from the count matrix, uniform over (host,
+    # lane) senders of that round (SPMD shapes must agree) but NOT over
+    # rounds — the skew-aware sizing
+    hl_counts = np.bincount(
+        (src_h * H + dst_h) * L + lane, minlength=H * H * L
+    ).reshape(H, H, L)
+    caps = tuple(
+        _shape_cap(hl_counts[np.arange(H), (np.arange(H) + r) % H, :].max())
+        for r in range(H)
+    )
+    offs = np.concatenate([[0], np.cumsum(caps)]).astype(np.int64)
+    B = int(offs[-1])
+    slot = ((src_h * H + dst_h) * L + lane).astype(np.int32)
+    rank = _pair_ranks(slot, H * H * L)
+    # sender of a row is device (src_h, lane): the host already moved it
+    # to its destination lane's buffer (the RAM ici leg)
+    send_pos = (src_h * L + lane) * B + offs[rnd] + rank
+    recv_pos = (dst_h * L + lane) * B + offs[rnd] + rank
+    sends = []
+    for p in payloads:
+        buf = np.zeros(D * B, dtype=p.dtype)
+        buf[send_pos] = p
+        sends.append(buf.reshape(H, L, B))
+    pack_s = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    hmesh = hierarchical_view(mesh, H)
+    out = _twostage_program(
+        hmesh,
+        tuple(jnp.asarray(s) for s in sends),
+        caps,
+    )
+    flats = [np.asarray(o).reshape(-1) for o in out]
+    exchange_s = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    out_perm, shard_offsets = canonical_order(bucket_ids, num_buckets, D)
+    gather_idx = recv_pos[out_perm]
+    out_cols = _threaded_gather(flats, gather_idx)
+    out_bucket = bucket_ids[out_perm]
+    unpack_s = _time.perf_counter() - t0
+    _publish_stats(
+        STRATEGY_TWOSTAGE,
+        D,
+        int(max(caps)),
+        counts,
+        {
+            "hosts": float(H),
+            "round_cap_max": float(max(caps)),
+            "round_cap_min": float(min(caps)),
+            **_timing(pack_s, exchange_s, unpack_s),
+        },
+    )
+    return out_bucket, out_cols, shard_offsets
+
+
+# ---------------------------------------------------------------------------
+# Resolution + host entry
+# ---------------------------------------------------------------------------
+
+
+def resolve_strategy(strategy: str, mesh, n_rows: int) -> str:
+    """Map the configured strategy (``hyperspace.build.exchange.
+    strategy``) to a concrete one. ``auto``:
+
+    * multi-process job → ``twostage`` (the DCN leg is the bottleneck;
+      docs/MULTIHOST.md);
+    * CPU mesh → ``host`` (the simulation must never pay ICI-emulation
+      costs);
+    * single-host accelerator → ``compact`` when the calibration probe
+      measured it beating ``flat`` at this row count
+      (``exchange_compact_min_rows``), else ``flat`` (the baseline and
+      TPU default).
+    """
+    s = (strategy or STRATEGY_AUTO).strip().lower()
+    if s != STRATEGY_AUTO and s not in STRATEGIES:
+        raise ValueError(
+            f"unknown exchange strategy {strategy!r}; expected one of "
+            f"{(STRATEGY_AUTO,) + STRATEGIES}"
+        )
+    if jax.process_count() > 1:
+        # a multi-process job has per-host inputs; only the twostage
+        # decomposition moves rows across the process boundary
+        if s not in (STRATEGY_AUTO, STRATEGY_TWOSTAGE):
+            _log.debug(
+                "exchange strategy %r coerced to twostage on a "
+                "multi-process job",
+                s,
+            )
+        return STRATEGY_TWOSTAGE
+    if s != STRATEGY_AUTO:
+        return s
+    if mesh.devices.flat[0].platform == "cpu":
+        return STRATEGY_HOST
+    from hyperspace_tpu.native import calibrate
+
+    t = calibrate.thresholds().exchange_compact_min_rows
+    if t and n_rows >= t:
+        return STRATEGY_COMPACT
+    return STRATEGY_FLAT
+
+
+def bucket_shuffle(
+    mesh,
+    key_reps: np.ndarray,
+    payloads: Sequence[np.ndarray],
+    num_buckets: int,
+    seed: int = 42,
+    with_shard_offsets: bool = False,
+    strategy: str = STRATEGY_AUTO,
+    twostage_hosts: int = 0,
+):
+    """Host entry: shuffle rows into bucket-contiguous order across the
+    mesh, via the selected exchange strategy (see module docstring).
+
+    Returns ``(bucket_ids, payload_cols)`` with all rows grouped by
+    bucket (global order: all rows of buckets owned by shard 0, then
+    shard 1, …; within a shard, ascending bucket id; within a bucket,
+    original row order). Every strategy produces bit-identical output.
+    The caller does the final within-bucket key sort (``ops/sort.py``)
+    before writing.
+
+    ``with_shard_offsets=True`` additionally returns the ``[D+1]`` row
+    offsets of each shard's slice — rows ``offsets[s]:offsets[s+1]`` are
+    exactly the buckets shard ``s`` owns (``bucket % D == s``), the
+    handle the sharded build/serve tail needs to keep bucket ownership
+    device-local past the exchange. A peer that owns no rows gets an
+    empty extent.
+    """
+    payloads = list(payloads)
+    name = resolve_strategy(strategy, mesh, key_reps.shape[1])
+    if name == STRATEGY_FLAT:
+        bucket, cols, offsets = _flat_exchange(
+            mesh, key_reps, payloads, num_buckets, seed
+        )
+    elif name == STRATEGY_HOST:
+        bucket, cols, offsets = _host_exchange(
+            mesh, key_reps, payloads, num_buckets, seed
+        )
+    elif name == STRATEGY_COMPACT:
+        bucket, cols, offsets = _compact_exchange(
+            mesh, key_reps, payloads, num_buckets, seed
+        )
+    else:
+        bucket, cols, offsets = _twostage_exchange(
+            mesh, key_reps, payloads, num_buckets, seed, twostage_hosts
+        )
+    if with_shard_offsets:
+        return bucket, cols, offsets
+    return bucket, cols

@@ -5,10 +5,13 @@ correctness dryrun; with the sharded build/serve tail the artifact must
 record THROUGHPUT: this script forces an ``n``-device CPU mesh (or uses
 real devices), runs the full framework dryrun first as a correctness
 gate, then times warm covering builds at ``HS_MESH_ROWS`` on 1 device
-and on the full mesh, with the per-stage breakdown (sort/write busy
-seconds across the shard tails vs ``tail_wall`` — their ratio is the
-per-shard overlap the single global permutation could never show) and
-the shuffle's exchange-cap/skew telemetry.
+and on the full mesh — once per exchange strategy in
+``HS_MESH_STRATEGIES`` — with the per-stage breakdown (sort/write busy
+seconds across the shard tails vs ``tail_wall``) and the exchange
+plane's telemetry: chosen strategy, pack/exchange/unpack stage seconds
+and the cap/skew numbers. ``mesh_speedup`` compares the single-device
+build against the FIRST listed strategy's full-mesh build (default
+``auto``, the shipping configuration).
 
 Prints exactly ONE JSON line on stdout (progress to stderr), in the
 MULTICHIP artifact shape (n_devices / rc / ok / skipped / tail) plus the
@@ -16,7 +19,9 @@ throughput fields.
 
 Usage:  python scripts/bench_mesh.py [n_devices]     (default 8)
 Env:    HS_MESH_ROWS (default 64_000_000), HS_MESH_BUCKETS (default 8),
-        HS_MESH_SIZES (default "1,<n_devices>")
+        HS_MESH_SIZES (default "1,<n_devices>"),
+        HS_MESH_STRATEGIES (default "auto" — e.g. "auto,flat,compact,
+        twostage" for a per-strategy A/B artifact)
 """
 
 import io
@@ -36,9 +41,10 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def timed_build(devices, rows, data_dir, num_buckets):
-    """Warm covering-index build on ``devices``: first build pays the
-    compiles/caches, the timed second build is steady state."""
+def timed_build(devices, rows, data_dir, num_buckets, strategy="auto"):
+    """Warm covering-index build on ``devices`` under ``strategy``: first
+    build pays the compiles/caches, the timed second build is steady
+    state."""
     from hyperspace_tpu import constants as C
     from hyperspace_tpu.hyperspace import Hyperspace
     from hyperspace_tpu.indexes.covering import CoveringIndexConfig
@@ -53,6 +59,10 @@ def timed_build(devices, rows, data_dir, num_buckets):
         session = HyperspaceSession(devices=devices)
         session.conf.set(C.INDEX_SYSTEM_PATH, root)
         session.conf.set(C.INDEX_NUM_BUCKETS, num_buckets)
+        session.conf.set(C.BUILD_EXCHANGE_STRATEGY, strategy)
+        if strategy == "twostage":
+            # single-controller simulation: carve the mesh in two hosts
+            session.conf.set(C.BUILD_EXCHANGE_TWOSTAGE_HOSTS, 2)
         hs = Hyperspace(session)
         df = session.read.parquet(data_dir)
         cfg = CoveringIndexConfig(
@@ -67,15 +77,22 @@ def timed_build(devices, rows, data_dir, num_buckets):
         t0 = time.perf_counter()
         hs.create_index(df, cfg)
         warm = time.perf_counter() - t0
+        telem = dict(last_build_telemetry)
         return {
             "devices": len(devices),
             "rows": rows,
+            "strategy": strategy,
+            "exchange_strategy": telem.get("shuffle_strategy", ""),
+            "exchange_stage_seconds": {
+                stage: telem.get(f"shuffle_{stage}_s", 0.0)
+                for stage in ("pack", "exchange", "unpack")
+            },
             "build_warm_s": round(warm, 3),
             "build_rows_per_sec": round(rows / warm),
             "build_stage_seconds": {
                 k: round(v, 3) for k, v in last_build_breakdown.items()
             },
-            "shuffle": dict(last_build_telemetry),
+            "shuffle": telem,
         }
     finally:
         shutil.rmtree(root, ignore_errors=True)
@@ -86,6 +103,11 @@ def main() -> int:
     rows = int(os.environ.get("HS_MESH_ROWS", 64_000_000))
     num_buckets = int(os.environ.get("HS_MESH_BUCKETS", 8))
     sizes_env = os.environ.get("HS_MESH_SIZES", f"1,{n_devices}")
+    strategies = [
+        s.strip()
+        for s in os.environ.get("HS_MESH_STRATEGIES", "auto").split(",")
+        if s.strip()
+    ]
 
     import __graft_entry__ as graft
 
@@ -98,6 +120,7 @@ def main() -> int:
         "skipped": False,
         "rows": rows,
         "num_buckets": num_buckets,
+        "strategies": strategies,
     }
     # 1. correctness gate: the full tiny-shape framework dryrun (create/
     # join/hybrid/refresh/delete/optimize, differentially checked)
@@ -114,7 +137,8 @@ def main() -> int:
     out["tail"] = tail[-1] if tail else ""
     log(out["tail"])
 
-    # 2. throughput: warm builds per mesh size over one shared dataset
+    # 2. throughput: warm builds per (mesh size, strategy) over one
+    # shared dataset; single-device rungs run once (no exchange)
     import bench as _bench
 
     tmp = tempfile.mkdtemp(prefix="hs_meshbench_")
@@ -125,18 +149,30 @@ def main() -> int:
         for d in [int(x) for x in sizes_env.split(",") if x.strip()]:
             if d > len(jax.devices()):
                 continue
-            log(f"building on {d} device(s) ...")
-            rung = timed_build(jax.devices()[:d], rows, items_dir, num_buckets)
-            log(
-                f"mesh{d}: {rung['build_warm_s']}s warm "
-                f"({rung['build_rows_per_sec']:,} rows/s); "
-                f"stages: {rung['build_stage_seconds']}"
-            )
-            mesh.append(rung)
+            for strategy in strategies if d > 1 else strategies[:1]:
+                log(f"building on {d} device(s), strategy={strategy} ...")
+                rung = timed_build(
+                    jax.devices()[:d], rows, items_dir, num_buckets, strategy
+                )
+                log(
+                    f"mesh{d}/{strategy}"
+                    f"[{rung['exchange_strategy'] or 'none'}]: "
+                    f"{rung['build_warm_s']}s warm "
+                    f"({rung['build_rows_per_sec']:,} rows/s); "
+                    f"stages: {rung['build_stage_seconds']}; "
+                    f"exchange: {rung['exchange_stage_seconds']}"
+                )
+                mesh.append(rung)
         out["mesh"] = mesh
-        if len(mesh) > 1:
+        base = [r for r in mesh if r["devices"] == 1]
+        full = [
+            r
+            for r in mesh
+            if r["devices"] > 1 and r["strategy"] == strategies[0]
+        ]
+        if base and full:
             out["mesh_speedup"] = round(
-                mesh[0]["build_warm_s"] / mesh[-1]["build_warm_s"], 3
+                base[0]["build_warm_s"] / full[0]["build_warm_s"], 3
             )
         out["ok"] = True
         print(json.dumps(out))

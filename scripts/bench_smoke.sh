@@ -109,6 +109,18 @@ for r in multi:
         "sharded tail did not run per shard: %r" % r
     )
     assert "skew_ratio" in {k.replace("shuffle_", "") for k in r["shuffle"]}, r
+    # the exchange-strategy plane must have reported which strategy ran,
+    # and on this CPU rung `auto` must have resolved to the host-side
+    # exchange (the simulation never pays ICI-emulation costs) with the
+    # pack/exchange/unpack stage telemetry recorded
+    assert r["shuffle"].get("shuffle_strategy") == "host", (
+        "CPU mesh rung did not auto-select the host exchange: %r"
+        % r["shuffle"]
+    )
+    for stage in ("pack", "exchange", "unpack"):
+        assert f"shuffle_{stage}_s" in r["shuffle"], r["shuffle"]
+    assert "shuffle_skew_ratio_max" in r["shuffle"], r["shuffle"]
+    assert "shuffle_skew_ratio_mean" in r["shuffle"], r["shuffle"]
 print("bench_smoke: rangeprune telemetry ok:", zp, file=sys.stderr)
 print("bench_smoke: mesh ladder ok:", multi[-1]["build_stage_seconds"],
       multi[-1]["shuffle"], file=sys.stderr)

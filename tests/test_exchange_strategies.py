@@ -1,0 +1,310 @@
+"""Exchange-strategy plane (``hyperspace.build.exchange.strategy``) —
+the differential matrix.
+
+The contract: every strategy (``host`` pure-RAM reorder, ``compact``
+host-packed exact-extent all_to_all, ``twostage`` DCN/ICI decomposition
+with per-peer round caps) produces BIT-IDENTICAL output to the ``flat``
+padded all_to_all baseline — same bucket ids, same payload rows in the
+same order, same ``with_shard_offsets`` extents — across mesh sizes,
+payload types (ints, strings via dictionary codes, validity masks,
+floats with NaNs), skews (uniform and one hot bucket) and the
+empty-shard edge (a peer that owns zero rows). Session-level legs check
+the parquet bytes of whole builds, including streaming waves.
+"""
+
+import hashlib
+import logging
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import jax
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+from hyperspace_tpu.parallel import shuffle as sh
+
+
+def _mesh(n_devices):
+    return jax.sharding.Mesh(
+        np.array(jax.devices()[:n_devices]), (sh.SHARD_AXIS,)
+    )
+
+
+def _payload_matrix(rng, n):
+    """One array per payload kind the build decomposes batches into:
+    int64 key reps/values, float64 with NaNs, int32 dictionary codes
+    (strings), bool validity masks."""
+    f = rng.normal(size=n)
+    f[rng.integers(0, 2, n).astype(bool)] = np.nan
+    return [
+        rng.integers(-(2**60), 2**60, n).astype(np.int64),
+        f,
+        rng.integers(0, 3, n).astype(np.int32),
+        rng.integers(0, 2, n).astype(bool),
+    ]
+
+
+def _keys(rng, n, skew):
+    if skew == "hot":  # every row hashes into ONE bucket
+        return np.full((1, n), 7, dtype=np.int64)
+    return rng.integers(0, 97, (2, n)).astype(np.int64)
+
+
+def _strategies_for(D):
+    out = [sh.STRATEGY_HOST, sh.STRATEGY_COMPACT]
+    if D > 1:
+        out.append(sh.STRATEGY_TWOSTAGE)
+    return out
+
+
+class TestStrategyDifferential:
+    @pytest.mark.parametrize("D", [1, 2, 8])
+    @pytest.mark.parametrize("skew", ["uniform", "hot"])
+    def test_bit_identical_to_flat(self, D, skew):
+        mesh = _mesh(D)
+        rng = np.random.default_rng(D * 31 + len(skew))
+        n, nb = 3001, 16
+        keys = _keys(rng, n, skew)
+        payloads = _payload_matrix(rng, n)
+        ref = sh.bucket_shuffle(
+            mesh, keys, payloads, nb, with_shard_offsets=True,
+            strategy=sh.STRATEGY_FLAT,
+        )
+        for strat in _strategies_for(D):
+            got = sh.bucket_shuffle(
+                mesh, keys, payloads, nb, with_shard_offsets=True,
+                strategy=strat, twostage_hosts=2,
+            )
+            np.testing.assert_array_equal(got[0], ref[0], err_msg=strat)
+            np.testing.assert_array_equal(got[2], ref[2], err_msg=strat)
+            assert len(got[1]) == len(ref[1])
+            for a, b in zip(got[1], ref[1]):
+                assert a.dtype == b.dtype, strat
+                np.testing.assert_array_equal(a, b, err_msg=strat)
+            assert sh.last_shuffle_stats["strategy"] == strat
+
+    def test_empty_peer_extents(self):
+        """num_buckets < D: some shards own no buckets and must report
+        empty ``with_shard_offsets`` extents in every strategy."""
+        mesh = _mesh(8)
+        rng = np.random.default_rng(3)
+        n, nb = 999, 3  # owners only 0..2 of 8 shards
+        keys = rng.integers(0, 50, (1, n)).astype(np.int64)
+        payloads = [np.arange(n, dtype=np.int64)]
+        ref = sh.bucket_shuffle(
+            mesh, keys, payloads, nb, with_shard_offsets=True,
+            strategy=sh.STRATEGY_FLAT,
+        )
+        assert (np.diff(ref[2])[nb:] == 0).all()
+        for strat in _strategies_for(8):
+            got = sh.bucket_shuffle(
+                mesh, keys, payloads, nb, with_shard_offsets=True,
+                strategy=strat, twostage_hosts=4,
+            )
+            np.testing.assert_array_equal(got[0], ref[0], err_msg=strat)
+            np.testing.assert_array_equal(got[2], ref[2], err_msg=strat)
+            np.testing.assert_array_equal(got[1][0], ref[1][0], err_msg=strat)
+
+    @pytest.mark.parametrize("hosts", [2, 4, 8])
+    def test_twostage_host_factorizations(self, hosts):
+        """Every (H, L) carve of the 8-device mesh lands the same rows."""
+        mesh = _mesh(8)
+        rng = np.random.default_rng(hosts)
+        n, nb = 2048, 16
+        keys = rng.integers(0, 200, (1, n)).astype(np.int64)
+        payloads = [keys[0], rng.normal(size=n)]
+        ref = sh.bucket_shuffle(
+            mesh, keys, payloads, nb, with_shard_offsets=True,
+            strategy=sh.STRATEGY_FLAT,
+        )
+        got = sh.bucket_shuffle(
+            mesh, keys, payloads, nb, with_shard_offsets=True,
+            strategy=sh.STRATEGY_TWOSTAGE, twostage_hosts=hosts,
+        )
+        np.testing.assert_array_equal(got[0], ref[0])
+        np.testing.assert_array_equal(got[2], ref[2])
+        for a, b in zip(got[1], ref[1]):
+            np.testing.assert_array_equal(a, b)
+        assert sh.last_shuffle_stats["hosts"] == float(hosts)
+
+    def test_canonical_order_is_flat_order(self):
+        """The host-side permutation equals the naive (owner, bucket,
+        row) lexsort — the invariant every non-flat strategy rides."""
+        rng = np.random.default_rng(11)
+        n, nb, D = 5000, 13, 8
+        ids = rng.integers(0, nb, n).astype(np.int32)
+        perm, offs = sh.canonical_order(ids, nb, D)
+        ref = np.lexsort((np.arange(n), ids, ids % D))
+        np.testing.assert_array_equal(perm, ref)
+        np.testing.assert_array_equal(
+            np.diff(offs), np.bincount(ids % D, minlength=D)
+        )
+
+    def test_resolve(self):
+        mesh = _mesh(8)
+        # CPU mesh: auto must pick the host-side exchange
+        assert sh.resolve_strategy("auto", mesh, 10**6) == sh.STRATEGY_HOST
+        assert sh.resolve_strategy("flat", mesh, 10) == sh.STRATEGY_FLAT
+        assert (
+            sh.resolve_strategy("TwoStage", mesh, 10)
+            == sh.STRATEGY_TWOSTAGE
+        )
+        with pytest.raises(ValueError, match="unknown exchange strategy"):
+            sh.resolve_strategy("bogus", mesh, 10)
+
+
+# ---------------------------------------------------------------------------
+# Session-level: whole builds, parquet bytes
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def mesh8(session_factory):
+    return session_factory(8)
+
+
+@pytest.fixture
+def mixed_parquet(tmp_path):
+    rng = np.random.default_rng(17)
+    d = tmp_path / "mixed"
+    d.mkdir()
+    for i in range(4):
+        n = 2500
+        vals = rng.normal(size=n)
+        t = pa.table(
+            {
+                "k": pa.array(rng.integers(0, 40, n), type=pa.int64()),
+                "s": pa.array(
+                    [["aa", "bb", "cc"][v] for v in rng.integers(0, 3, n)]
+                ),
+                "v": pa.array(
+                    [None if j % 13 == 0 else vals[j] for j in range(n)],
+                    type=pa.float64(),
+                ),
+            }
+        )
+        pq.write_table(t, d / f"part-{i}.parquet")
+    return str(d)
+
+
+def _sha(path):
+    with open(path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def _build(session, src, name, strategy, budget=0, hosts=0):
+    session.conf.set(C.BUILD_EXCHANGE_STRATEGY, strategy)
+    session.conf.set(C.BUILD_EXCHANGE_TWOSTAGE_HOSTS, hosts)
+    session.conf.set(C.INDEX_BUILD_MEMORY_BUDGET, budget)
+    hs = Hyperspace(session)
+    df = session.read.parquet(src)
+    hs.create_index(df, CoveringIndexConfig(name, ["k"], ["s", "v"]))
+    entry = session.index_manager.get_index_log_entry(name)
+    return sorted(entry.content.files)
+
+
+def _assert_identical_files(files_a, files_b, tag):
+    assert [os.path.basename(f) for f in files_a] == [
+        os.path.basename(f) for f in files_b
+    ], tag
+    for fa, fb in zip(files_a, files_b):
+        assert _sha(fa) == _sha(fb), f"{tag}: parquet bytes differ: {fa}"
+
+
+class TestBuildDifferential:
+    def test_in_memory_builds_bit_identical(self, mesh8, mixed_parquet):
+        ref = _build(mesh8, mixed_parquet, "exflat", "flat")
+        from hyperspace_tpu.indexes.covering_build import last_build_telemetry
+
+        for strat in ("auto", "host", "compact", "twostage"):
+            files = _build(
+                mesh8, mixed_parquet, f"ex{strat}", strat, hosts=2
+            )
+            _assert_identical_files(files, ref, strat)
+            expect = "host" if strat == "auto" else strat
+            assert last_build_telemetry["shuffle_strategy"] == expect
+
+    def test_streaming_waves_bit_identical(self, mesh8, mixed_parquet):
+        from hyperspace_tpu.indexes.covering_build import (
+            per_file_materialized_bytes,
+        )
+
+        first = sorted(os.listdir(mixed_parquet))[0]
+        per_file = per_file_materialized_bytes(
+            [os.path.join(mixed_parquet, first)], "parquet"
+        )[0]
+        budget = int(per_file * 1.5)  # several waves
+        ref = _build(mesh8, mixed_parquet, "stflat", "flat", budget=budget)
+        from hyperspace_tpu.indexes.covering_build import last_build_telemetry
+
+        for strat in ("host", "compact", "twostage"):
+            files = _build(
+                mesh8, mixed_parquet, f"st{strat}", strat,
+                budget=budget, hosts=2,
+            )
+            _assert_identical_files(files, ref, strat)
+            assert last_build_telemetry["shuffle_waves"] > 1
+            assert "shuffle_skew_ratio_max" in last_build_telemetry
+            assert "shuffle_skew_ratio_mean" in last_build_telemetry
+
+    def test_stage_seconds_and_strategy_in_telemetry(self, mesh8, mixed_parquet):
+        from hyperspace_tpu.indexes.covering_build import last_build_telemetry
+
+        _build(mesh8, mixed_parquet, "tele", "auto")
+        t = last_build_telemetry
+        assert t["shuffle_strategy"] == "host"
+        for key in ("shuffle_pack_s", "shuffle_exchange_s", "shuffle_unpack_s"):
+            assert key in t, t
+        assert t["shuffle_devices"] == 8.0
+
+
+class TestSkewWarnRateLimit:
+    def test_streaming_build_warns_once(self, mesh8, tmp_path, caplog):
+        """A skewed streaming build runs one exchange per wave; the skew
+        warning must fire ONCE per build while telemetry records every
+        wave as a max/mean pair."""
+        d = tmp_path / "skew"
+        d.mkdir()
+        # per wave (one file), every shard sends all its rows to ONE
+        # peer: n/8 per (shard, peer) slot must clear the warn floor
+        n = 40000
+        t = pa.table(
+            {
+                "k": pa.array(np.full(n, 7), type=pa.int64()),
+                "s": pa.array(["x"] * n),
+                "v": pa.array(np.ones(n)),
+            }
+        )
+        for i in range(4):
+            pq.write_table(t, d / f"p{i}.parquet")
+        from hyperspace_tpu.indexes.covering_build import (
+            last_build_telemetry,
+            per_file_materialized_bytes,
+        )
+
+        per_file = per_file_materialized_bytes(
+            [str(d / "p0.parquet")], "parquet"
+        )[0]
+        with caplog.at_level(logging.WARNING, "hyperspace_tpu.shuffle"):
+            _build(
+                mesh8, str(d), "skew1x", "auto", budget=int(per_file * 1.5)
+            )
+        warns = [r for r in caplog.records if "shuffle skew" in r.message]
+        assert len(warns) == 1, warns
+        tele = last_build_telemetry
+        assert tele["shuffle_waves"] > 1
+        assert tele["shuffle_skew_ratio_max"] >= C.BUILD_SHUFFLE_SKEW_WARN_RATIO
+        assert tele["shuffle_skew_ratio_mean"] > 1.0
+        # a second build warns again (fresh latch per data op)
+        caplog.clear()
+        with caplog.at_level(logging.WARNING, "hyperspace_tpu.shuffle"):
+            _build(
+                mesh8, str(d), "skew2x", "auto", budget=int(per_file * 1.5)
+            )
+        warns = [r for r in caplog.records if "shuffle skew" in r.message]
+        assert len(warns) == 1, warns

@@ -162,8 +162,11 @@ class TestShuffle:
         n, nb = 1003, 16  # deliberately not divisible by 8
         keys = rng.integers(0, 50, (1, n)).astype(np.int64)
         payload = rng.integers(0, 10**9, n).astype(np.int64)
+        # pin the flat strategy: this test exercises the device
+        # all_to_all itself (auto resolves a CPU mesh to the host-side
+        # exchange; tests/test_exchange_strategies.py covers the matrix)
         buckets, (keys_out, payload_out) = bucket_shuffle(
-            mesh, keys, [keys[0], payload], nb
+            mesh, keys, [keys[0], payload], nb, strategy="flat"
         )
         # No rows lost or duplicated.
         assert len(buckets) == n
@@ -185,7 +188,9 @@ class TestShuffle:
         n = 64
         keys = np.arange(n, dtype=np.int64)[None, :]
         payload = np.arange(n, dtype=np.int64) * 1000
-        _, (k_out, p_out) = bucket_shuffle(mesh, keys, [keys[0], payload], 4)
+        _, (k_out, p_out) = bucket_shuffle(
+            mesh, keys, [keys[0], payload], 4, strategy="flat"
+        )
         np.testing.assert_array_equal(k_out * 1000, p_out)
 
 
@@ -230,7 +235,9 @@ def test_shuffle_cap_bounds_memory_and_preserves_rows():
     reps = np.zeros((1, n), dtype=np.int64)
     assert _exchange_cap(reps, valid, D * 4, D, 42) == n_local
     payload = np.arange(n, dtype=np.int64)
-    buckets, cols = bucket_shuffle(mesh, reps, [reps[0], payload], D * 4)
+    buckets, cols = bucket_shuffle(
+        mesh, reps, [reps[0], payload], D * 4, strategy="flat"
+    )
     assert len(buckets) == n
     assert sorted(cols[1].tolist()) == list(range(n))
 
@@ -238,7 +245,9 @@ def test_shuffle_cap_bounds_memory_and_preserves_rows():
     reps = rng.integers(-(2**60), 2**60, size=(1, n), dtype=np.int64)
     cap = _exchange_cap(reps, valid, D * 4, D, 42)
     assert cap < n_local // 2, cap
-    buckets, cols = bucket_shuffle(mesh, reps, [reps[0], payload], D * 4)
+    buckets, cols = bucket_shuffle(
+        mesh, reps, [reps[0], payload], D * 4, strategy="flat"
+    )
     assert len(buckets) == n
     assert sorted(cols[1].tolist()) == list(range(n))
 
