@@ -21,6 +21,30 @@ mistaken for a dead one. The named crash points
 after_end_log here; mid_data_write / mid_vacuum_delete at the data
 seams) let the test matrix kill the writer between any two protocol
 steps and assert recovery.
+
+Multi-process jobs (docs/MULTIHOST.md "collective symmetry doctrine"):
+the metadata plane stays single-writer — only the coordinator
+(``MeshRuntime.is_coordinator``, process 0) runs recovery, the OCC
+begin/commit log writes (:func:`_publish_log`) and the latestStable
+publish (:func:`_publish_latest_stable`), via
+:meth:`Action._run_coordinated`; every other process runs the
+data-plane replica (:meth:`Action._run_data_plane`): the same snapshot
++ validate discipline, then ``op()`` — whose exchange collectives and
+``_global_written`` barrier every process must reach identically.
+Three ABORT-AWARE rendezvous (:func:`_action_rendezvous`, a registered
+``per-host-lane`` collective site: an allgather of per-process step
+verdicts) order the protocol and make every one-sided failure a
+job-wide typed error instead of a hang: workers snapshot only after
+the coordinator's recovery repair (``recovered``), workers finish
+validating before the coordinator's begin entry exists (``validate`` —
+a worker must never see its own action's transient state; a no-op
+verdict must be unanimous), and no worker enters the data plane before
+the begin entry is durable (``begin`` — a crash mid-op must leave a
+rollbackable transient tip, and a begin-write OCC loss aborts the
+workers instead of stranding them). One action at a time per
+multi-process job: the OCC retry loop is disabled on the coordinator
+because a silent re-validate on one process would desynchronize the
+rendezvous program.
 """
 
 from __future__ import annotations
@@ -38,6 +62,54 @@ from hyperspace_tpu.metadata.entry import IndexLogEntry
 from hyperspace_tpu.metadata.log_manager import IndexLogManager
 from hyperspace_tpu.telemetry import HyperspaceEvent
 from hyperspace_tpu.testing import faults
+
+
+def _multiprocess() -> bool:
+    import jax
+
+    return jax.process_count() > 1
+
+
+#: per-process step verdicts exchanged at each rendezvous
+_STEP_FAIL, _STEP_PROCEED, _STEP_NOOP = 0, 1, 2
+
+
+def _action_rendezvous(step: str, verdict: int) -> int:
+    """Abort-aware cross-process rendezvous of the action protocol:
+    allgather every process's verdict for ``step`` and return the
+    unanimous one. Any process reporting failure — or a proceed/no-op
+    disagreement — raises on EVERY process, so a one-sided exception
+    (a begin-write OCC loss on the coordinator, a validate error on one
+    worker) becomes a job-wide typed abort instead of peers blocking
+    forever in a barrier. Registered in ``COLLECTIVE_SITES`` as
+    ``per-host-lane``: same sequence position on every process, each
+    carrying its own verdict payload. Callers guard with
+    :func:`_multiprocess` — a single-process job has no peers to meet."""
+    import numpy as np
+    from jax.experimental import multihost_utils as mhu
+
+    flags = np.asarray(
+        mhu.process_allgather(np.asarray([verdict], dtype=np.int32))
+    ).ravel()
+    if (flags == _STEP_FAIL).any() or len(set(flags.tolist())) > 1:
+        raise ConcurrentWriteException(
+            f"multi-process action aborted at step {step!r}: per-process "
+            f"verdicts {flags.tolist()} (0=failed, 1=proceed, 2=no-op)"
+        )
+    return int(flags[0])
+
+
+def _publish_log(log_manager: IndexLogManager, log_id: int, entry) -> bool:
+    """Coordinator-gated OCC log write (``COLLECTIVE_SITES``): the
+    operation log has exactly one writer per action — on a multi-process
+    job only the coordinator ever reaches this seam."""
+    return log_manager.write_log(log_id, entry)
+
+
+def _publish_latest_stable(log_manager: IndexLogManager, log_id: int) -> bool:
+    """Coordinator-gated latestStable pointer publish — the same
+    single-writer metadata seam as the log entries themselves."""
+    return log_manager.create_latest_stable_log(log_id)
 
 
 class Action(abc.ABC):
@@ -85,6 +157,12 @@ class Action(abc.ABC):
     def run(self) -> None:
         from hyperspace_tpu.metadata import recovery
 
+        if _multiprocess():
+            if self.session.runtime.is_coordinator:
+                self._run_coordinated()
+            else:
+                self._run_data_plane()
+            return
         conf = self.session.conf
         recovery_on = conf.recovery_enabled
         attempts = conf.recovery_retry_max_attempts if recovery_on else 1
@@ -111,7 +189,7 @@ class Action(abc.ABC):
             if recovery_on:
                 recovery.stamp_lease(begin, owner, lease_ms)
             begin.id = self.base_id + 1
-            if self.log_manager.write_log(self.base_id + 1, begin):
+            if _publish_log(self.log_manager, self.base_id + 1, begin):
                 break
             if attempt >= attempts:
                 raise ConcurrentWriteException(
@@ -130,7 +208,7 @@ class Action(abc.ABC):
             faults.crash("after_data_write", type(self).__name__)
             final = self.log_entry().with_state(self.final_state)
             final.id = self.base_id + 2
-            if not self.log_manager.write_log(self.base_id + 2, final):
+            if not _publish_log(self.log_manager, self.base_id + 2, final):
                 # the end id exists already: a cancel()/recovery rolled
                 # our transient entry back under us — the data work must
                 # not be published over their write
@@ -138,7 +216,7 @@ class Action(abc.ABC):
                     f"Concurrent write at log id {self.base_id + 2}"
                 )
             faults.crash("after_end_log", type(self).__name__)
-            self.log_manager.create_latest_stable_log(self.base_id + 2)
+            _publish_latest_stable(self.log_manager, self.base_id + 2)
         except Exception as e:
             self._log_event(False, str(e))
             raise
@@ -148,6 +226,126 @@ class Action(abc.ABC):
             # thread dies with it, and the lease starts aging
             if heartbeat is not None:
                 heartbeat.stop()
+        self._log_event(True)
+
+    def _rendezvous_step(self, step: str, fn) -> int:
+        """Run one protocol step locally, then rendezvous on its
+        verdict. The local exception (if any) wins over the collective
+        abort, so the failing process reports its own root cause while
+        its peers get the typed ConcurrentWriteException instead of
+        blocking forever."""
+        verdict, err = _STEP_PROCEED, None
+        try:
+            fn()
+        except NoChangesException:
+            verdict = _STEP_NOOP
+        # deliberate catch-all: the verdict must reach the peers (they
+        # are entering the same allgather) BEFORE this process unwinds
+        except Exception as e:  # hslint: disable=HS402
+            verdict, err = _STEP_FAIL, e
+        try:
+            return _action_rendezvous(step, verdict)
+        except ConcurrentWriteException:
+            if err is not None:
+                raise err
+            raise
+
+    def _run_coordinated(self) -> None:
+        """The coordinator side of a multi-process action: the
+        single-writer metadata plane plus the shared data plane, with an
+        abort-aware rendezvous at each protocol step (module
+        docstring). ONE begin-write attempt — an OCC loss aborts the
+        whole job symmetrically at the ``begin`` rendezvous rather than
+        silently re-validating out of sync with the workers (one action
+        at a time per multi-process job)."""
+        from hyperspace_tpu.metadata import recovery
+
+        conf = self.session.conf
+        recovery_on = conf.recovery_enabled
+        lease_ms = conf.recovery_lease_ms
+        owner = recovery.new_owner_id()
+
+        def repair():
+            # a dead writer's leavings repair BEFORE anyone snapshots:
+            # the rendezvous orders every worker's snapshot after this
+            if recovery_on:
+                recovery.ensure_recovered(self.log_manager, lease_ms)
+
+        self._rendezvous_step("recovered", repair)
+
+        def snapshot_validate():
+            self._resnapshot()
+            self.validate()
+
+        if self._rendezvous_step("validate", snapshot_validate) == _STEP_NOOP:
+            self._log_event(True, "No-op action")
+            return
+
+        begin_box = []
+
+        def begin_write():
+            # only now may the transient entry appear — every worker
+            # has finished validating (the rendezvous above), so none
+            # can mistake our own begin entry for a concurrent writer
+            begin = self.begin_log_entry().with_state(self.transient_state)
+            if recovery_on:
+                recovery.stamp_lease(begin, owner, lease_ms)
+            begin.id = self.base_id + 1
+            if not _publish_log(self.log_manager, self.base_id + 1, begin):
+                raise ConcurrentWriteException(
+                    f"Another operation is in progress (log id "
+                    f"{self.base_id + 1} already exists)"
+                )
+            begin_box.append(begin)
+
+        self._rendezvous_step("begin", begin_write)
+        heartbeat = None
+        if recovery_on:
+            heartbeat = recovery.LeaseHeartbeat(
+                self.log_manager, self.base_id + 1, begin_box[0], owner,
+                lease_ms,
+            ).start()
+        try:
+            self.op()
+            final = self.log_entry().with_state(self.final_state)
+            final.id = self.base_id + 2
+            if not _publish_log(self.log_manager, self.base_id + 2, final):
+                raise ConcurrentWriteException(
+                    f"Concurrent write at log id {self.base_id + 2}"
+                )
+            _publish_latest_stable(self.log_manager, self.base_id + 2)
+        except Exception as e:
+            self._log_event(False, str(e))
+            raise
+        finally:
+            if heartbeat is not None:
+                heartbeat.stop()
+        self._log_event(True)
+
+    def _run_data_plane(self) -> None:
+        """The non-coordinator replica of :meth:`_run_coordinated`: the
+        identical rendezvous program and the identical ``op()``
+        collective program, but NO log writes, no recovery, no lease —
+        the coordinator owns the metadata plane (ROADMAP item 4; this
+        process already receives the global file list through
+        ``_global_written``'s barrier + union listing)."""
+        self._rendezvous_step("recovered", lambda: None)
+
+        def snapshot_validate():
+            # ordered AFTER the coordinator's recovery repair by the
+            # rendezvous above: both sides validate the repaired log
+            self._resnapshot()
+            self.validate()
+
+        if self._rendezvous_step("validate", snapshot_validate) == _STEP_NOOP:
+            self._log_event(True, "No-op action")
+            return
+        self._rendezvous_step("begin", lambda: None)
+        try:
+            self.op()
+        except Exception as e:
+            self._log_event(False, str(e))
+            raise
         self._log_event(True)
 
     def _log_event(self, success: bool, message: str = "") -> None:

@@ -1,6 +1,6 @@
 """hslint — repo-native static analysis for hyperspace_tpu.
 
-Seven checkers guard the correctness-critical seams nothing else checks
+Eight checkers guard the correctness-critical seams nothing else checks
 mechanically (see ``docs/static-analysis.md``):
 
 * :mod:`kernel_parity` (HS1xx) — every native C++ export has a
@@ -18,7 +18,14 @@ mechanically (see ``docs/static-analysis.md``):
   static lock model against a runtime witness artifact;
 * :mod:`contracts` (HS7xx) — config keys have constants defaults and
   ``docs/CONFIG.md`` rows, fault points are matrix-tested, dead keys
-  are flagged.
+  are flagged;
+* :mod:`spmd` (HS8xx) — every collective call site declares its
+  symmetry contract in ``COLLECTIVE_SITES``
+  (``parallel/collectives.py``), process-identity branches and
+  process-local loop bounds cannot make processes issue diverging
+  collective programs, and ``--witness`` cross-checks the per-process
+  runtime collective sequences recorded by
+  ``testing/collective_witness.py``.
 
 Run it: ``python -m hyperspace_tpu.analysis [package_dir]`` — exits
 nonzero when any unsuppressed finding remains. Suppress a finding with
@@ -41,6 +48,7 @@ from hyperspace_tpu.analysis import (
     log_state,
     purity,
     shared_state,
+    spmd,
 )
 from hyperspace_tpu.analysis.core import FINDING_FIELDS, Finding, Project
 
@@ -61,6 +69,7 @@ CHECKERS = (
     locks,
     shared_state,
     contracts,
+    spmd,
 )
 
 #: rule id -> one-line description; HS001 is the analyzer's own

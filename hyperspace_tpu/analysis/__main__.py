@@ -49,11 +49,17 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--witness",
         metavar="ARTIFACT",
+        action="append",
         default=None,
-        help="cross-check a runtime lock-witness artifact "
-        "(testing/lock_witness.py JSON) against the static lock model: "
-        "witnessed edges/locks absent from the model are hard HS604 "
-        "errors; static edges never witnessed print as warnings",
+        help="cross-check a runtime witness artifact against the static "
+        "model (repeatable). A lock-witness JSON "
+        "(testing/lock_witness.py) checks the lock model: witnessed "
+        "edges/locks absent from it are hard HS604 errors. A "
+        "collective-witness prefix (testing/collective_witness.py; "
+        "per-process <prefix>.p<i>.json files) merges the per-process "
+        "collective sequences: any cross-process divergence or "
+        "unregistered witnessed site is a hard HS804 error. Static "
+        "edges / registered sites never witnessed print as warnings",
     )
     args = parser.parse_args(argv)
 
@@ -79,21 +85,36 @@ def main(argv=None) -> int:
             run_analysis(p, tests_dir=args.tests_dir, project=project)
         )
 
-    if args.witness is not None:
-        from hyperspace_tpu.analysis import shared_state
+    for witness in args.witness or ():
+        # ONE cross-check per artifact against the union of the analyzed
+        # packages' models: an artifact records every wrapped lock /
+        # registered site in its process, so a per-package comparison
+        # would call each package's surface "unknown" to the other.
+        # Artifact kind is sniffed from its content: a lock witness is a
+        # single JSON file with a "locks" map; a collective witness is a
+        # per-process <prefix>.p<i>.json family (or one such file).
+        from hyperspace_tpu.analysis import shared_state, spmd
 
         try:
-            doc = shared_state.load_witness(args.witness)
+            doc = None
+            if os.path.isfile(witness):
+                import json as _json
+
+                with open(witness, "r", encoding="utf-8") as f:
+                    doc = _json.load(f)
+            if isinstance(doc, dict) and "locks" in doc:
+                lock_doc = shared_state.load_witness(witness, doc=doc)
+                gaps, warnings = shared_state.witness_cross_check(
+                    projects, lock_doc, os.path.basename(witness)
+                )
+            else:
+                docs = spmd.load_collective_witness(witness)
+                gaps, warnings = spmd.collective_cross_check(
+                    projects, docs, os.path.basename(witness)
+                )
         except (OSError, ValueError) as exc:
             print(f"error: bad witness artifact: {exc}", file=sys.stderr)
             return 2
-        # ONE cross-check against the union of the analyzed packages'
-        # lock models: the artifact records every wrapped lock in the
-        # process, so a per-package comparison would call each package's
-        # locks "unknown" to the other
-        gaps, warnings = shared_state.witness_cross_check(
-            projects, doc, os.path.basename(args.witness)
-        )
         all_findings.extend(gaps)
         for w in warnings:
             print(f"hslint: warning: {w}", file=sys.stderr)

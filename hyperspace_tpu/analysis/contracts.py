@@ -18,7 +18,13 @@ mechanical:
   never appears in ``tests/test_faults.py``, or a crash point
   (``CRASH_POINTS``) never appears in ``tests/test_crash_recovery.py``:
   the point × mode (and crash point × action) matrices are the tested
-  contract, an unexercised point is an untested failure mode.
+  contract, an unexercised point is an untested failure mode. The same
+  rule covers the multi-host plane: a ``COLLECTIVE_SITES`` entry
+  (``parallel/collectives.py``) that never appears in
+  ``scripts/dryrun_multihost.py`` — by dotted path or trailing callable
+  name, the prefix-family discipline — is a collective the dryrun's
+  witness matrix can never exercise, so a newly added collective cannot
+  ship unwitnessed.
 * HS704 — a dead key: a ``hyperspace.*`` token documented in
   ``docs/CONFIG.md`` that no constants entry backs (or that nothing
   reads), or a key constant in ``constants.py`` that nothing reads —
@@ -50,6 +56,7 @@ FAULTS_FILE = "testing/faults.py"
 FAULT_TESTS = "test_faults.py"
 CRASH_TESTS = "test_crash_recovery.py"
 CONFIG_DOC = "CONFIG.md"
+DRYRUN_FILE = "dryrun_multihost.py"
 
 _GETTERS = frozenset(
     {"get", "get_bool", "get_int", "get_float", "get_str", "set", "unset"}
@@ -265,4 +272,35 @@ def check(project: Project) -> List[Finding]:
                             "matrix has a hole",
                         )
                     )
+
+    # -- HS703 (collective plane): every COLLECTIVE_SITES entry must be
+    # exercised by the multi-host dryrun's witness matrix — prefix-family
+    # match: the full dotted site path or its trailing callable name
+    from hyperspace_tpu.analysis import spmd as _spmd
+
+    site_entries, site_rel = _spmd.parse_sites(project)
+    if site_entries:
+        dryrun = project.aux_lines("scripts", DRYRUN_FILE)
+        if dryrun is not None:
+            text = "\n".join(dryrun)
+            site_sf = project.file(site_rel)
+            site_path = (
+                site_sf.rel_path if site_sf is not None else site_rel
+            )
+            for e in site_entries:
+                token = e.path.rsplit(".", 1)[-1]
+                if e.path in text or token in text:
+                    continue
+                findings.append(
+                    Finding(
+                        "HS703",
+                        site_path,
+                        e.line,
+                        f"collective site {e.path!r} is registered in "
+                        f"COLLECTIVE_SITES but never appears in "
+                        f"scripts/{DRYRUN_FILE} — the dryrun's witness "
+                        "matrix has a hole; add it to a WITNESS_* tuple "
+                        "and drive (or explicitly exclude) it",
+                    )
+                )
     return findings

@@ -149,7 +149,14 @@ class Project:
         """Lines of ``docs/<name>`` next to the package (the contract
         checker reads ``CONFIG.md``), or None when absent — fixture
         trees without docs simply skip the doc-backed rules."""
-        p = os.path.join(os.path.dirname(self.package_dir), "docs", name)
+        return self.aux_lines("docs", name)
+
+    def aux_lines(self, *relpath: str) -> Optional[List[str]]:
+        """Lines of any file next to the package (the contract checker
+        reads ``scripts/dryrun_multihost.py`` for the collective-site
+        witness matrix), or None when absent — fixture trees without it
+        simply skip the file-backed rules."""
+        p = os.path.join(os.path.dirname(self.package_dir), *relpath)
         if not os.path.isfile(p):
             return None
         with open(p, "r", encoding="utf-8") as f:

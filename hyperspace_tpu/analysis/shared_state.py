@@ -940,12 +940,15 @@ def check(project: Project) -> List[Finding]:
 # ---------------------------------------------------------------------------
 
 
-def load_witness(path: str) -> dict:
+def load_witness(path: str, doc: Optional[dict] = None) -> dict:
     """Parse a witness artifact; raises ValueError on a malformed one
     (the CLI maps that to a usage error — a corrupt artifact must never
-    pass as 'zero model gaps', nor crash with a traceback)."""
-    with open(path, "r", encoding="utf-8") as f:
-        doc = json.load(f)
+    pass as 'zero model gaps', nor crash with a traceback). Pass a
+    pre-parsed ``doc`` to validate it without re-reading the file (the
+    CLI already parsed it to sniff the artifact kind)."""
+    if doc is None:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
     if not isinstance(doc, dict) or "locks" not in doc or "edges" not in doc:
         raise ValueError(f"not a lock-witness artifact: {path}")
     locks = doc["locks"]

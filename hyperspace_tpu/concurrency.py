@@ -193,6 +193,20 @@ SHARED_STATE: Dict[str, Tuple[str, str, str]] = {
         "fired counters updated inside fire() and snapshotted by stats() "
         "under the one registry lock",
     ),
+    # -- collective witness (testing/collective_witness.py) ------------------
+    "hyperspace_tpu.testing.collective_witness._records": (
+        "hyperspace_tpu.testing.collective_witness._rec_lock",
+        "guarded",
+        "the per-process ordered collective sequence: record/snapshot/"
+        "reset all hold the recorder lock (install/uninstall are "
+        "single-threaded test setup by contract)",
+    ),
+    "hyperspace_tpu.testing.collective_witness._wave_counts": (
+        "hyperspace_tpu.testing.collective_witness._rec_lock",
+        "guarded",
+        "per-site wave counters incremented with the matching sequence "
+        "append under the same recorder lock",
+    ),
     # -- import-time registries ----------------------------------------------
     "hyperspace_tpu.indexes.registry._REGISTRY": (
         "",

@@ -745,7 +745,12 @@ def _global_written(ctx, written: List[str]) -> List[str]:
     job every host wrote only the buckets its shards own, so after a
     cross-host barrier the (deterministically named, bucket-id-ordered)
     union is listed from the data dir — every process returns the same
-    global list for the coordinator's log entry."""
+    global list for the coordinator's log entry.
+
+    Registered in ``COLLECTIVE_SITES`` (``parallel/collectives.py``,
+    contract ``per-host-lane``): every ``write_bucketed`` exit path must
+    reach this barrier on every process — zero-row stripes included —
+    or the peers hang (hslint HS8xx enforces the shape)."""
     import jax
 
     if jax.process_count() <= 1:

@@ -44,6 +44,10 @@ def initialize_distributed(
     multi-host dryrun — the coordination service needs them explicitly,
     plus the gloo cross-process collectives backend and a forced local
     device count (``cpu_local_devices``).
+
+    Registered in ``COLLECTIVE_SITES`` (``parallel/collectives.py``):
+    the bootstrap is itself part of the collective program the HS8xx
+    sanitizer and the runtime collective witness check.
     """
     global _DISTRIBUTED_INITIALIZED
     if _DISTRIBUTED_INITIALIZED:

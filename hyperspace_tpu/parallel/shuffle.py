@@ -54,6 +54,14 @@ mechanical across mesh sizes, payload types and skews.
 host batches — the wave loop is ``indexes/covering_build.
 _write_bucketed_streaming``, driven by
 ``hyperspace.index.build.memoryBudgetBytes``.)
+
+Every device program here that issues a collective (``_flat_program``,
+``_compact_program``, ``_twostage_program``, and the
+``process_allgather`` in ``_twostage_exchange_mp``) is registered in
+``COLLECTIVE_SITES`` (``parallel/collectives.py``) with its symmetry
+contract — add a collective without registering it and hslint HS802
+goes red; the multi-host dryrun's collective witness then has to
+exercise it (HS703/HS804).
 """
 
 from __future__ import annotations

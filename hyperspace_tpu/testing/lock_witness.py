@@ -35,7 +35,6 @@ in ``testing/``.
 from __future__ import annotations
 
 import importlib
-import json
 import os
 import threading
 from typing import Dict, List, Optional, Tuple
@@ -254,29 +253,23 @@ def snapshot() -> dict:
 def dump(path: str, merge: bool = True) -> dict:
     """Write the witness artifact, summing counts with any existing one
     at ``path`` (several suites can accumulate into one artifact), via
-    the temp + atomic-replace publish pattern. Returns the document."""
+    the shared temp + fsync + atomic-replace publish helper
+    (``testing/artifacts.py`` — the ``calibrate._store_cache`` pattern,
+    also used by the collective witness). Returns the document."""
+    from hyperspace_tpu.testing import artifacts
+
     doc = snapshot()
-    if merge and os.path.exists(path):
-        try:
-            with open(path, "r", encoding="utf-8") as f:
-                prev = json.load(f)
-        except (OSError, ValueError):
-            prev = None
-        if isinstance(prev, dict):
-            for name, n in prev.get("locks", {}).items():
-                doc["locks"][name] = doc["locks"].get(name, 0) + n
-            merged: Dict[Tuple[str, str], int] = {
-                (a, b): n for a, b, n in doc["edges"]
-            }
-            for a, b, n in prev.get("edges", []):
-                merged[(a, b)] = merged.get((a, b), 0) + n
-            doc["edges"] = sorted([a, b, n] for (a, b), n in merged.items())
-            for state, meta in prev.get("entries", {}).items():
-                doc["entries"].setdefault(state, meta)
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(doc, f, indent=2, sort_keys=True)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    prev = artifacts.load_json(path) if merge else None
+    if prev is not None:
+        artifacts.merge_count_maps(doc["locks"], prev.get("locks", {}))
+        merged: Dict[Tuple[str, str], int] = {
+            (a, b): n for a, b, n in doc["edges"]
+        }
+        artifacts.merge_count_maps(
+            merged, {(a, b): n for a, b, n in prev.get("edges", [])}
+        )
+        doc["edges"] = sorted([a, b, n] for (a, b), n in merged.items())
+        for state, meta in prev.get("entries", {}).items():
+            doc["entries"].setdefault(state, meta)
+    artifacts.atomic_write_json(path, doc)
     return doc

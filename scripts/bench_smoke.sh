@@ -34,6 +34,22 @@ JAX_PLATFORMS=cpu python -m hyperspace_tpu.analysis hyperspace_tpu/ \
     --witness "$WITNESS"
 echo "bench_smoke: lock-witness cross-check ok (zero model gaps)" >&2
 rm -f "$WITNESS"
+# The multi-host plane rides the same doctrine: the 2-process dryrun runs
+# under the COLLECTIVE witness (testing/collective_witness.py) — every
+# COLLECTIVE_SITES-registered call site records each process's ordered
+# collective sequence into <prefix>.p<i>.json — and hslint --witness
+# merges the per-process artifacts, gating on zero cross-process sequence
+# divergence and zero unregistered witnessed sites (HS804), including
+# through the coordinator-gated CREATE metadata path the dryrun drives.
+CW_DIR="$(mktemp -d -t hs_collective_witness.XXXXXX)"
+CW="$CW_DIR/cw"
+HS_COLLECTIVE_WITNESS="$CW" JAX_PLATFORMS=cpu python scripts/dryrun_multihost.py
+test -s "$CW.p0.json" && test -s "$CW.p1.json" \
+    || { echo "bench_smoke: collective witness artifacts missing" >&2; exit 1; }
+JAX_PLATFORMS=cpu python -m hyperspace_tpu.analysis hyperspace_tpu/ \
+    --witness "$CW"
+echo "bench_smoke: collective-witness cross-check ok (zero divergence)" >&2
+rm -rf "$CW_DIR"
 OUT=$(JAX_PLATFORMS=cpu \
 HS_BENCH_FORCE_CPU_DEVICES=8 \
 HS_BENCH_ROWS="$ROWS" \

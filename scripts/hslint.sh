@@ -14,6 +14,13 @@
 #                                          #   witness artifact (recorded by
 #                                          #   HS_LOCK_WITNESS=wit.json pytest
 #                                          #   runs) against the static model
+#   scripts/hslint.sh --witness cw         # + merge + cross-check per-process
+#                                          #   COLLECTIVE witness artifacts
+#                                          #   (cw.p<i>.json, recorded by
+#                                          #   HS_COLLECTIVE_WITNESS=cw
+#                                          #   scripts/dryrun_multihost.py):
+#                                          #   any cross-process sequence
+#                                          #   divergence is a hard HS804 error
 #
 # Rule docs: docs/static-analysis.md
 set -euo pipefail

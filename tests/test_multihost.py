@@ -1,11 +1,15 @@
-"""Multi-host bootstrap dryrun (docs/MULTIHOST.md).
+"""Multi-host bootstrap + collective-witness dryrun (docs/MULTIHOST.md).
 
 Runs ``scripts/dryrun_multihost.py`` — 2 REAL processes x 4 CPU devices
 joined via ``initialize_distributed`` (gloo collectives) — asserting the
-flat shard-axis ``all_to_all``/``psum`` and the hierarchical (dcn, ici)
-two-stage reduction both execute across the process boundary. This is
-the CPU stand-in for the reference's delegated-to-Spark multi-node
-scaling (SURVEY §2.11 driver/executor row).
+flat shard-axis ``all_to_all``/``psum``, the hierarchical (dcn, ici)
+two-stage reduction, the process-local twostage bucket exchange AND a
+2-process CREATE end to end (coordinator-gated metadata plane: one log
+entry pair, identical global content on both processes). The run is
+armed with ``HS_COLLECTIVE_WITNESS`` so each process records its ordered
+collective sequence, and the test then merges the per-process artifacts
+and requires ZERO cross-process divergence and zero unregistered
+witnessed sites — the HS804 loop ``scripts/bench_smoke.sh`` gates on.
 """
 
 import os
@@ -13,11 +17,13 @@ import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG_DIR = os.path.join(REPO, "hyperspace_tpu")
 
 
-def test_two_process_dryrun():
+def test_two_process_dryrun(tmp_path):
     script = os.path.join(REPO, "scripts", "dryrun_multihost.py")
-    env = dict(os.environ)
+    prefix = str(tmp_path / "cw")
+    env = dict(os.environ, HS_COLLECTIVE_WITNESS=prefix)
     # the workers manage their own platform/device config; drop the test
     # session's forced XLA flags so they don't fight the workers'
     env.pop("XLA_FLAGS", None)
@@ -31,3 +37,21 @@ def test_two_process_dryrun():
     )
     assert out.returncode == 0, out.stdout + out.stderr
     assert out.stdout.count("DRYRUN-OK") == 2, out.stdout + out.stderr
+
+    # merge the per-process artifacts and cross-check: zero divergence,
+    # zero unregistered witnessed sites, coordinator gating honored
+    from hyperspace_tpu.analysis import spmd
+    from hyperspace_tpu.analysis.core import Project
+
+    docs = spmd.load_collective_witness(prefix)
+    assert [d["process"] for d in docs] == [0, 1], docs
+    project = Project(PKG_DIR)
+    findings, _warnings = spmd.collective_cross_check(
+        [project], docs, "cw"
+    )
+    assert findings == [], "\n".join(f.render() for f in findings)
+    # the CREATE must have driven the coordinator-gated metadata path
+    p0_sites = {r["site"] for r in docs[0]["sequence"]}
+    p1_sites = {r["site"] for r in docs[1]["sequence"]}
+    assert "hyperspace_tpu.actions.base._publish_log" in p0_sites
+    assert "hyperspace_tpu.actions.base._publish_log" not in p1_sites
