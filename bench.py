@@ -291,6 +291,101 @@ def main() -> None:
             f"{gagg_stats.get('rows_passed', 0):,} passing rows"
         )
 
+        # --- aggregate index plane (docs/agg-serve.md): a fully-covered
+        # grouped point aggregate answered from the _aggstate sidecar
+        # with ZERO parquet row groups read, A/B'd interleaved against
+        # the fused pass (hyperspace.index.agg.enabled off forces the
+        # PR 7 path on the SAME plan); then the sampling plane's
+        # approximate COUNT/SUM vs exact. The dedicated single-column
+        # z-order index keeps row groups range-sorted on the filter key
+        # so whole-row-group coverage is real, not a bucket accident.
+        from hyperspace_tpu.indexes.zorder import (
+            ZOrderCoveringIndexConfig as _ZCfg,
+        )
+
+        hs.create_index(
+            items,
+            _ZCfg("agg_idx", ["l_orderkey"], ["l_quantity", "l_extendedprice"]),
+        )
+
+        def q_meta(df):
+            return (
+                df.filter(df["l_orderkey"] >= 0)
+                .group_by("l_quantity")
+                .agg(
+                    hsf.count().alias("n"),
+                    hsf.min("l_orderkey").alias("kmin"),
+                    hsf.max("l_orderkey").alias("kmax"),
+                    hsf.sum("l_orderkey").alias("ksum"),
+                )
+            )
+
+        _pc._NATIVE_FUSED_PIPELINE_MIN_ROWS = 1 << 10
+        session.enable_hyperspace()
+        _pc.last_aggplane_stats = {}
+        meta_rows = q_meta(items).collect().num_rows
+        meta_stats = {
+            k: (round(v, 4) if isinstance(v, float) else v)
+            for k, v in _pc.last_aggplane_stats.items()
+        }
+        t_meta, t_fused_ab = [], []
+        rows_a = rows_b = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            rows_a = q_meta(items).collect().num_rows
+            t_meta.append(time.perf_counter() - t0)
+            session.conf.set(C.INDEX_AGG_ENABLED, False)
+            t0 = time.perf_counter()
+            rows_b = q_meta(items).collect().num_rows
+            t_fused_ab.append(time.perf_counter() - t0)
+            session.conf.unset(C.INDEX_AGG_ENABLED)
+        assert rows_a == rows_b == meta_rows, (rows_a, rows_b, meta_rows)
+        meta_ab = (_ab_stats(t_meta), _ab_stats(t_fused_ab))
+        log(
+            "agg-metadata p50: sidecar "
+            f"{meta_ab[0]['p50'] * 1e3:.2f}ms vs fused "
+            f"{meta_ab[1]['p50'] * 1e3:.2f}ms "
+            f"({meta_ab[1]['p50'] / meta_ab[0]['p50']:.1f}x); "
+            f"{meta_stats.get('row_groups_metadata', 0)}/"
+            f"{meta_stats.get('row_groups_total', 0)} row groups from "
+            f"metadata, {meta_stats.get('rows_scanned', 0)} rows read"
+        )
+
+        # approximate plane: bounded-error COUNT/SUM from the stratified
+        # sample (explicit opt-in; exact collect() is never substituted)
+        from hyperspace_tpu.execution import approx_exec as _apx
+
+        session.conf.set(C.SERVE_APPROX_ENABLED, True)
+        q_apx = items.filter(
+            (items["l_orderkey"] >= agg_lo) & (items["l_orderkey"] < agg_hi)
+        ).agg(hsf.count().alias("n"), hsf.sum("l_quantity").alias("sq"))
+        est = q_apx.collect_approx(max_rel_error=1.0)
+        t_apx = timeit(
+            lambda: q_apx.collect_approx(max_rel_error=1.0), reps
+        )
+        t_exact = timeit(lambda: q_apx.collect(), reps)
+        truth = q_apx.collect()
+        e = est.to_pydict()
+        tn = truth.column("n").to_pylist()[0]
+        ts_ = truth.column("sq").to_pylist()[0]
+        apx_stats = dict(_apx.last_approx_stats)
+        n_in_ci = bool(e["n_lo"][0] <= tn <= e["n_hi"][0])
+        s_in_ci = bool(e["sq_lo"][0] <= ts_ <= e["sq_hi"][0])
+        n_err = abs(e["n"][0] - tn) / max(tn, 1)
+        log(
+            f"agg-approx p50: estimate {t_apx['p50'] * 1e3:.2f}ms vs exact "
+            f"{t_exact['p50'] * 1e3:.2f}ms; COUNT rel err {n_err:.4f} "
+            f"(bound held: n={n_in_ci}, sum={s_in_ci}; "
+            f"{apx_stats.get('sample_rows', 0):,} sampled of "
+            f"{apx_stats.get('population_rows', 0):,} rows)"
+        )
+        session.conf.unset(C.SERVE_APPROX_ENABLED)
+        _pc._NATIVE_FUSED_PIPELINE_MIN_ROWS = _fused_min_saved
+        session.disable_hyperspace()
+        hs.delete_index("agg_idx")
+        hs.vacuum_index("agg_idx")
+        session.index_manager.clear_cache()
+
         # --- indexed join (JoinIndexRule, co-bucketed, shuffle-free)
         def q_join(o, i):
             return o.join(i, on=o["o_orderkey"] == i["l_orderkey"]).select(
@@ -1007,6 +1102,32 @@ def main() -> None:
                         ),
                         "fused_ran": gagg_stats.get("mode") == "agg",
                         "stats": gagg_stats,
+                    },
+                    "agg_metadata": {
+                        "metadata_p50_ms": ms(meta_ab[0]),
+                        "metadata_iqr_ms": iqr_ms(meta_ab[0]),
+                        "fused_p50_ms": ms(meta_ab[1]),
+                        "fused_iqr_ms": iqr_ms(meta_ab[1]),
+                        "metadata_speedup": round(
+                            meta_ab[1]["p50"] / meta_ab[0]["p50"], 3
+                        ),
+                        "metadata_ran": meta_stats.get("mode")
+                        == "agg_metadata",
+                        "stats": meta_stats,
+                    },
+                    "agg_approx": {
+                        "approx_p50_ms": ms(t_apx),
+                        "approx_iqr_ms": iqr_ms(t_apx),
+                        "exact_p50_ms": ms(t_exact),
+                        "exact_iqr_ms": iqr_ms(t_exact),
+                        "count_rel_err": round(n_err, 6),
+                        "count_bound_held": n_in_ci,
+                        "sum_bound_held": s_in_ci,
+                        "stats": {
+                            k: v
+                            for k, v in apx_stats.items()
+                            if k != "wall_s"
+                        },
                     },
                     "join_indexed_p50_ms": ms(join_idx),
                     "join_indexed_iqr_ms": iqr_ms(join_idx),

@@ -95,10 +95,12 @@ class CreateAction(Action):
         )
         index.write(ctx, index_data)
         # zone-map sidecar for the range serve plane (best-effort: the
-        # serve path backfills from parquet footers when absent)
-        from hyperspace_tpu.indexes import zonemaps
+        # serve path backfills from parquet footers when absent), and the
+        # aggregate-plane partials/sample sidecars (docs/agg-serve.md)
+        from hyperspace_tpu.indexes import aggindex, zonemaps
 
         zonemaps.capture_safely(self.index_data_path, index)
+        aggindex.capture_safely(self.index_data_path, index, self.session.conf)
         self._index = index
 
     def _enriched_properties(self) -> Dict[str, str]:

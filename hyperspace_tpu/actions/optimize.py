@@ -90,10 +90,15 @@ class OptimizeAction(Action):
         ctx = IndexerContext(self.session, self.tracker, self.index_data_path)
         files, self._ignored = self._partition_files()
         self._previous.derived_dataset.optimize(ctx, files)
-        from hyperspace_tpu.indexes import zonemaps
+        from hyperspace_tpu.indexes import aggindex, zonemaps
 
         zonemaps.capture_safely(
             self.index_data_path, self._previous.derived_dataset
+        )
+        aggindex.capture_safely(
+            self.index_data_path,
+            self._previous.derived_dataset,
+            self.session.conf,
         )
 
     def log_entry(self) -> IndexLogEntry:

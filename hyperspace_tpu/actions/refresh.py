@@ -186,9 +186,12 @@ class RefreshAction(RefreshActionBase):
         ctx = IndexerContext(self.session, self.tracker, self.index_data_path)
         df = self._df_over(list(self.source_relation().plan_relation.files))
         self._index = self._previous.derived_dataset.refresh_full(ctx, df)
-        from hyperspace_tpu.indexes import zonemaps
+        from hyperspace_tpu.indexes import aggindex, zonemaps
 
         zonemaps.capture_safely(self.index_data_path, self._index)
+        aggindex.capture_safely(
+            self.index_data_path, self._index, self.session.conf
+        )
 
     def log_entry(self) -> IndexLogEntry:
         content = Content.from_directory_scan(self.index_data_path, self.tracker)
@@ -227,10 +230,15 @@ class RefreshIncrementalAction(RefreshActionBase):
             ctx, appended_df, deleted_ids, self._previous.content
         )
         # new version dir only: files from earlier versions keep their own
-        # sidecars (MERGE mode), so zone maps stay consistent per dir
-        from hyperspace_tpu.indexes import zonemaps
+        # sidecars (MERGE mode), so zone maps / aggregate partials stay
+        # consistent per dir — an incremental refresh folds ONLY the
+        # appended files' partials (earlier dirs' sidecars are untouched)
+        from hyperspace_tpu.indexes import aggindex, zonemaps
 
         zonemaps.capture_safely(self.index_data_path, self._index)
+        aggindex.capture_safely(
+            self.index_data_path, self._index, self.session.conf
+        )
 
     def log_entry(self) -> IndexLogEntry:
         new_content = Content.from_directory_scan(

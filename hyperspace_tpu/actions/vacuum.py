@@ -66,6 +66,8 @@ class VacuumOutdatedAction(_StateFlipAction):
     def op(self) -> None:
         """Delete non-latest version dirs + unreferenced files in retained
         dirs (VacuumOutdatedAction.op:86-120)."""
+        from hyperspace_tpu.utils import paths as path_utils
+
         live_files = set(self._previous.content.files)
         live_versions = {
             v
@@ -81,9 +83,25 @@ class VacuumOutdatedAction(_StateFlipAction):
                 continue
             root = self.data_manager.get_path(version)
             for path, _s, _m in file_utils.list_leaf_files(root):
+                # underscore/hidden sidecars (_zonemaps.json, _aggstate.
+                # json, _aggsample.parquet) are never in the content, so
+                # the live-file check must not delete them from RETAINED
+                # dirs — a sidecar is dropped with the dir it describes.
+                # Crash-leaked publish temps (.<name>.tmp.<pid>) ARE
+                # garbage, though: vacuum is their only sweeper.
+                if not path_utils.is_data_path(path):
+                    if ".tmp." in os.path.basename(path):
+                        file_utils.delete(path)
+                    continue
                 if path not in live_files:
                     faults.crash("mid_vacuum_delete", path)
                     file_utils.delete(path)
+            # rewrite the aggregate-plane sidecars to drop entries for
+            # the files just deleted (per-file staleness would defuse
+            # them anyway; this keeps the sidecar ≡ the dir's files)
+            from hyperspace_tpu.indexes import aggindex
+
+            aggindex.prune_missing(root)
 
     @staticmethod
     def _version_of(path: str):

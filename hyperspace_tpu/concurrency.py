@@ -94,6 +94,13 @@ SHARED_STATE: Dict[str, Tuple[str, str, str]] = {
         "bounded LRU shared by every serve thread when serve-cache mode "
         "is off; get/put/evict/clear all run under the one lock",
     ),
+    "hyperspace_tpu.indexes.aggindex._local_cache": (
+        "hyperspace_tpu.indexes.aggindex._local_lock",
+        "guarded",
+        "bounded LRU of assembled aggregate-plane state shared by every "
+        "serve thread when serve-cache mode is off; get/put/evict/clear "
+        "all run under the one lock",
+    ),
     "hyperspace_tpu.execution.serve_cache.ServeCache._entries": (
         "self._lock",
         "guarded",
@@ -158,6 +165,18 @@ SHARED_STATE: Dict[str, Tuple[str, str, str]] = {
         "rebind-only",
         "fused-pass telemetry of the most recent execution, published as "
         "one rebind of a freshly-built dict",
+    ),
+    "hyperspace_tpu.execution.pipeline_compiler.last_aggplane_stats": (
+        "",
+        "rebind-only",
+        "metadata-plane telemetry of the most recent execution, "
+        "published as one rebind of a freshly-built dict",
+    ),
+    "hyperspace_tpu.execution.approx_exec.last_approx_stats": (
+        "",
+        "rebind-only",
+        "approximate-serve telemetry of the most recent estimate, "
+        "published as one rebind of a freshly-built dict",
     ),
     # -- recovery plane (metadata/recovery.py) -------------------------------
     "hyperspace_tpu.metadata.recovery._active_pins": (

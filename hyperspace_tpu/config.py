@@ -216,6 +216,46 @@ class Config:
         )
 
     @property
+    def index_agg_enabled(self) -> bool:
+        """Aggregate index plane (docs/agg-serve.md): sidecar capture of
+        partial-aggregate state at build time, the serve-side metadata
+        lowering, and the AggregateIndexRule rewrite."""
+        return self.get_bool(C.INDEX_AGG_ENABLED, C.INDEX_AGG_ENABLED_DEFAULT)
+
+    @property
+    def index_agg_max_groups(self) -> int:
+        """Per-row-group distinct-value cap for grouped-partial capture."""
+        return max(
+            0, self.get_int(C.INDEX_AGG_MAX_GROUPS, C.INDEX_AGG_MAX_GROUPS_DEFAULT)
+        )
+
+    @property
+    def index_agg_sample_rows(self) -> int:
+        """Stratified-sample rows captured per row group (0 = none)."""
+        return max(
+            0,
+            self.get_int(C.INDEX_AGG_SAMPLE_ROWS, C.INDEX_AGG_SAMPLE_ROWS_DEFAULT),
+        )
+
+    @property
+    def serve_approx_enabled(self) -> bool:
+        """Explicit opt-in for sample-based approximate aggregates
+        (``DataFrame.collect_approx``); never substituted for exact."""
+        return self.get_bool(
+            C.SERVE_APPROX_ENABLED, C.SERVE_APPROX_ENABLED_DEFAULT
+        )
+
+    @property
+    def serve_approx_max_rel_error(self) -> float:
+        """Widest acceptable 95%-CI half-width relative to the estimate."""
+        return max(
+            0.0,
+            self.get_float(
+                C.SERVE_APPROX_MAX_REL_ERROR, C.SERVE_APPROX_MAX_REL_ERROR_DEFAULT
+            ),
+        )
+
+    @property
     def serve_cache_enabled(self) -> bool:
         return self.get_bool(
             C.SERVE_CACHE_ENABLED, C.SERVE_CACHE_ENABLED_DEFAULT

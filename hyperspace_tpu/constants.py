@@ -242,6 +242,49 @@ EXECUTION_DEVICE_FILTER_MIN_ROWS_DEFAULT = 8_000_000
 EXECUTION_DEVICE_JOIN_MIN_ROWS = "hyperspace.execution.deviceJoinMinRows"
 EXECUTION_DEVICE_JOIN_MIN_ROWS_DEFAULT = 0  # 0 = never on single device
 
+# -- aggregate index plane (indexes/aggindex.py, docs/agg-serve.md) ----------
+# Master switch for the aggregate/approximate index plane: build-time
+# capture of per-row-group partial-aggregate state into an
+# ``_aggstate.json`` sidecar (+ the ``_aggsample.parquet`` stratified
+# row sample), the serve-side metadata lowering that answers fully-
+# covered Filter(→Project)→Aggregate plans from those partials without
+# opening a single parquet file, and the AggregateIndexRule rewrite of
+# bare Aggregate∘Scan plans onto a covering index. Off = the pre-plane
+# behavior everywhere (no capture, no metadata lowering, no rewrite).
+INDEX_AGG_ENABLED = "hyperspace.index.agg.enabled"
+INDEX_AGG_ENABLED_DEFAULT = True
+
+# Grouped-partial capture cap: per row group, single-column grouped
+# partials are captured only for (fusable) columns whose distinct-value
+# count in that row group stays at/below this. Row groups over the cap
+# simply have no grouped entry for that key and fall back to the fused
+# scan at serve time — a cap, never a correctness knob.
+INDEX_AGG_MAX_GROUPS = "hyperspace.index.agg.maxGroupsPerRowGroup"
+INDEX_AGG_MAX_GROUPS_DEFAULT = 256
+
+# Stratified-sample size: rows sampled per row group (without
+# replacement, seeded by (file, row group) so capture and lazy backfill
+# produce the same sample) into the ``_aggsample.parquet`` sidecar that
+# serves the approximate plane. 0 disables sampling at capture.
+INDEX_AGG_SAMPLE_ROWS = "hyperspace.index.agg.sampleRowsPerGroup"
+INDEX_AGG_SAMPLE_ROWS_DEFAULT = 128
+
+# Approximate serving (execution/approx_exec.py): explicit opt-in for
+# sample-based COUNT/SUM estimates with 95% confidence intervals via
+# ``DataFrame.collect_approx()``. NEVER substituted for exact answers —
+# the exact serve path ignores samples entirely; with the flag off,
+# ``collect_approx`` raises instead of estimating.
+SERVE_APPROX_ENABLED = "hyperspace.serve.approx.enabled"
+SERVE_APPROX_ENABLED_DEFAULT = False
+
+# Per-query error budget: the widest acceptable 95%-CI half-width
+# relative to the estimate's magnitude. Estimates whose interval blows
+# the budget raise ApproximationError (run exact instead) rather than
+# returning a number the caller would over-trust. Overridable per query
+# via ``collect_approx(max_rel_error=...)``.
+SERVE_APPROX_MAX_REL_ERROR = "hyperspace.serve.approx.maxRelativeError"
+SERVE_APPROX_MAX_REL_ERROR_DEFAULT = 0.05
+
 # -- serve-server mode (execution/serve_cache.py) ----------------------------
 # Opt-in cache of decoded index data (batches, prepared join sides) in
 # host RAM, keyed by the immutable index file set — the data-plane

@@ -43,6 +43,20 @@ class LogCorruptedError(HyperspaceException):
         self.reason = reason
 
 
+class ApproximationError(HyperspaceException):
+    """The approximate serve plane cannot honestly answer this query
+    (``execution/approx_exec.py``): approx serving is disabled, the plan
+    is not served by a sampled covering index, an aggregate is outside
+    the estimable set (COUNT/SUM), or the 95% confidence interval blows
+    the per-query error budget.
+
+    Deliberately TYPED and raised instead of degrading: an approximate
+    answer is only ever produced through the explicit
+    ``DataFrame.collect_approx`` opt-in, and a bound the estimator
+    cannot meet must surface as "run exact", never as a number the
+    caller would over-trust."""
+
+
 class ServeOverloadedError(HyperspaceException):
     """Admission control shed this query: the serve frontend's queue of
     admitted-but-not-running queries reached

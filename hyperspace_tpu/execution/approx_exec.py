@@ -1,0 +1,204 @@
+"""Approximate serve plane: sample-based COUNT/SUM with error bounds.
+
+Approximate Distributed Joins in Apache Spark (PAPERS.md) argues
+interactive traffic will happily trade exactness for latency — IF the
+error is bounded and reported. This module serves ungrouped COUNT /
+COUNT(col) / SUM estimates from the stratified per-row-group row sample
+the aggregate index plane captures (``indexes/aggindex.py``,
+``_aggsample.parquet``), with 95% confidence intervals from classical
+stratified-sampling theory:
+
+* strata are (file, row group); within stratum ``h`` of ``N_h`` rows,
+  ``n_h`` rows were sampled uniformly without replacement;
+* a COUNT estimate is ``Σ_h N_h·p_h`` with variance
+  ``Σ_h N_h²·p_h(1-p_h)/n_h·(1-n_h/N_h)`` (finite-population
+  correction: a fully-sampled stratum contributes zero variance);
+* a SUM estimate uses ``y_i = v_i·1{row passes}`` (nulls contribute 0)
+  with the stratified mean estimator ``Σ_h N_h·ȳ_h`` and variance
+  ``Σ_h N_h²·s²_h/n_h·(1-n_h/N_h)``.
+
+Contract (docs/agg-serve.md): approximate answers are produced ONLY
+through the explicit ``DataFrame.collect_approx()`` opt-in behind
+``hyperspace.serve.approx.enabled`` — the exact serve path never touches
+samples — and an estimate whose interval blows the per-query error
+budget (``hyperspace.serve.approx.maxRelativeError`` or the
+``max_rel_error=`` override) raises a typed
+:class:`~hyperspace_tpu.exceptions.ApproximationError` instead of
+returning a number the caller would over-trust.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from hyperspace_tpu.exceptions import ApproximationError
+from hyperspace_tpu.plan.nodes import Aggregate, Filter, Project, Scan
+
+#: 97.5th percentile of the standard normal — two-sided 95% interval
+_Z95 = 1.959963984540054
+
+# Telemetry of the LAST approximate serve (rebind-only, like the fused
+# stats): strata counts, sample size, per-agg relative half-widths.
+last_approx_stats: Dict[str, Any] = {}
+
+
+def _match_plan(plan):
+    """(cond | None, scan) when the optimized plan is an ungrouped
+    Aggregate over [Project] [Filter] Scan, else None."""
+    if not isinstance(plan, Aggregate) or plan.group_by:
+        return None
+    node = plan.child
+    while isinstance(node, Project):
+        node = node.child
+    if isinstance(node, Filter) and isinstance(node.child, Scan):
+        return node.condition, node.child
+    if isinstance(node, Scan):
+        return None, node
+    return None
+
+
+def approx_aggregate(
+    session, plan, max_rel_error: Optional[float] = None
+) -> pa.Table:
+    """Estimate an ungrouped COUNT/SUM aggregate from the stratified
+    index sample. Returns one row with, per aggregate ``x``, columns
+    ``x`` (the estimate), ``x_lo`` and ``x_hi`` (the 95% CI) — all
+    float64, so an approximate answer can never be mistaken for the
+    exact integer result. Raises :class:`ApproximationError` whenever an
+    honest bounded estimate is impossible."""
+    global last_approx_stats
+    if session is None or not session.conf.serve_approx_enabled:
+        raise ApproximationError(
+            "approximate serving is disabled; set "
+            "hyperspace.serve.approx.enabled=true to opt in"
+        )
+    budget = (
+        session.conf.serve_approx_max_rel_error
+        if max_rel_error is None
+        else float(max_rel_error)
+    )
+    t0 = time.perf_counter()
+    optimized = session.optimize(plan)
+    m = _match_plan(optimized)
+    if m is None:
+        raise ApproximationError(
+            "only ungrouped Filter→Aggregate plans are approximable"
+        )
+    cond, scan = m
+    rel = scan.relation
+    from hyperspace_tpu.execution import executor as X
+
+    if rel.index_info is None or not X._cacheable_scan(rel):
+        raise ApproximationError(
+            "the plan is not served by a clean covering-index scan "
+            "(no index, or query-shaped compensation is in play) — "
+            "run exact instead"
+        )
+    for spec in plan.aggs:
+        if spec.func not in ("count", "sum"):
+            raise ApproximationError(
+                f"{spec.func}() is not estimable from a sample; "
+                "approximable aggregates are COUNT and SUM"
+            )
+    from hyperspace_tpu.indexes import aggindex
+
+    sample = aggindex.sample_data_for(rel, session.conf)
+    if sample is None:
+        raise ApproximationError(
+            "no stratified sample is available for this index "
+            "(capture disabled, or a file is unreadable)"
+        )
+    from hyperspace_tpu.io.columnar import ColumnarBatch
+
+    batch = ColumnarBatch.from_arrow(sample["table"])
+    ns = batch.num_rows
+    if cond is not None:
+        passing = X._filter_mask(cond, batch, session).astype(bool)
+    else:
+        passing = np.ones(ns, dtype=bool)
+    if not bool(passing.any()):
+        # zero passing sample rows: the sample carries no information
+        # about the selection's values and the normal interval collapses
+        # to [0, 0] — refusing is the only honest answer
+        raise ApproximationError(
+            "no sampled row satisfies the predicate — the selection is "
+            "too rare to estimate from the sample; run exact"
+        )
+    stratum = sample["stratum"]
+    N = sample["N"].astype(np.float64)
+    n = sample["n"].astype(np.float64)
+    if bool(np.any((n < 2) & (n < N))) :
+        # a partially-sampled stratum with one sample row has no
+        # estimable variance (ddof=1 is undefined) — a zero-width
+        # "interval" from it would be categorically false, so refuse
+        # (a fully-sampled singleton stratum is exact and fine)
+        raise ApproximationError(
+            "a stratum has a single sampled row but more than one "
+            "population row — variance is not estimable; enlarge "
+            "hyperspace.index.agg.sampleRowsPerGroup or run exact"
+        )
+    H = len(N)
+    fpc = np.clip(1.0 - n / N, 0.0, 1.0)
+    out: Dict[str, Any] = {}
+    rel_errs = []
+    for spec in plan.aggs:
+        if spec.func == "count":
+            if spec.column is None:
+                y = passing.astype(np.float64)
+            else:
+                col = batch.column(spec.column)
+                nm = col.null_mask
+                valid = (
+                    np.ones(ns, dtype=bool) if nm is None else ~nm
+                )
+                y = (passing & valid).astype(np.float64)
+        else:  # sum
+            col = batch.column(spec.column)
+            if col.kind != "numeric":
+                raise ApproximationError(
+                    f"sum() over non-numeric column {spec.column!r}"
+                )
+            v = col.values.astype(np.float64, copy=False)
+            nm = col.null_mask
+            if nm is not None:
+                v = np.where(nm, 0.0, v)
+            y = np.where(passing, v, 0.0)
+        # per-stratum mean and (ddof=1) variance of y
+        sums = np.bincount(stratum, weights=y, minlength=H)
+        sq = np.bincount(stratum, weights=y * y, minlength=H)
+        mean = sums / n
+        with np.errstate(invalid="ignore", divide="ignore"):
+            var_h = np.where(
+                n > 1, (sq - n * mean * mean) / (n - 1), 0.0
+            )
+        var_h = np.maximum(var_h, 0.0)
+        est = float(np.sum(N * mean))
+        var = float(np.sum(N * N * var_h / n * fpc))
+        hw = _Z95 * np.sqrt(max(var, 0.0))
+        out[spec.name] = est
+        out[spec.name + "_lo"] = est - hw
+        out[spec.name + "_hi"] = est + hw
+        rel_err = hw / abs(est) if est != 0.0 else (0.0 if hw == 0.0 else np.inf)
+        rel_errs.append((spec.name, rel_err))
+        if rel_err > budget:
+            raise ApproximationError(
+                f"estimate for {spec.name!r} has relative 95%-CI "
+                f"half-width {rel_err:.4f} > budget {budget:.4f} — "
+                "run exact, or widen the budget / enlarge "
+                "hyperspace.index.agg.sampleRowsPerGroup"
+            )
+    last_approx_stats = {
+        "mode": "agg_approx",
+        "strata": H,
+        "sample_rows": int(ns),
+        "population_rows": int(sample["N"].sum()),
+        "rel_half_widths": {k: float(v) for k, v in rel_errs},
+        "wall_s": time.perf_counter() - t0,
+    }
+    return pa.table(
+        {k: pa.array([v], type=pa.float64()) for k, v in out.items()}
+    )

@@ -83,8 +83,18 @@ def _exec(plan: LogicalPlan, needed: Set[str], session) -> ColumnarBatch:
     if isinstance(plan, Aggregate):
         from hyperspace_tpu.execution.pipeline_compiler import (
             try_fused_aggregate,
+            try_metadata_aggregate,
         )
 
+        # aggregate index plane (docs/agg-serve.md): a strictly-lowered
+        # Filter(→Project)→Aggregate over a clean index scan answers
+        # fully-covered row groups from the persisted partial-aggregate
+        # sidecar WITHOUT reading them, scans only the boundary chunks,
+        # and merges through the shared partials layer — bit-identical
+        # to the chains below
+        served = try_metadata_aggregate(plan, session)
+        if served is not None:
+            return served
         # fused serve-pipeline compiler (docs/serve-compiler.md): a
         # Filter(→Project)→Aggregate subtree over a pruned index scan
         # runs as one fused native pass per row-group chunk — predicate,

@@ -67,6 +67,13 @@ from hyperspace_tpu.plan.nodes import (
 # rows scanned vs rows passed, group count, chunk count, wall seconds.
 last_fused_stats: Dict[str, Any] = {}
 
+# Telemetry of the LAST metadata-plane aggregate (docs/agg-serve.md):
+# how many row groups were answered from persisted partials vs scanned
+# vs provably empty, and how many rows the boundary chunks actually read
+# — the smoke gate asserts row_groups_scanned == 0 for a fully-covered
+# point aggregate.
+last_aggplane_stats: Dict[str, Any] = {}
+
 
 # ---------------------------------------------------------------------------
 # Dispatch
@@ -332,6 +339,37 @@ def _lower_fused_agg(
 # ---------------------------------------------------------------------------
 
 
+@dataclasses.dataclass
+class AggPartials:
+    """The PUBLIC snapshot of one fused aggregation's carried chunk
+    state — the stable hook through which the build-time sidecar capture
+    (``indexes/aggindex.py``), the serve-time metadata merge and the
+    kernel sweep all share ONE state layout instead of re-deriving it.
+
+    Arrays are sliced to the live group count ``G``; group order is the
+    producer's insertion/first-occurrence order (output ordering happens
+    once, in :func:`finalize_partials`). Per agg slot the accumulators
+    mean exactly what the kernel's mean: ``acc_cnt`` = valid-row count
+    (passing-row count for COUNT(*)), ``acc_i`` = wrapped int64 sums or
+    int min/max (identity-filled when the group has no valid rows),
+    ``acc_f`` = float sums or min/max over CLEAN (non-NaN valid) values,
+    ``acc_aux`` = the float min/max side channel (clean count for MIN,
+    NaN count for MAX)."""
+
+    n_groups: int
+    rows_scanned: int
+    rows_passed: int
+    g_reps: np.ndarray  # (nk, G) canonical key reps (Column.key_rep)
+    g_nulls: np.ndarray  # (nk, G) uint8 null plane
+    g_kvals: np.ndarray  # (nk, G) first-occurrence raw key bits (int64 view)
+    g_kvalid: np.ndarray  # (nk, G) uint8 validity of the stored key value
+    key_has_validity: Tuple[bool, ...]
+    acc_i: np.ndarray  # (na, G) int64 accumulators
+    acc_f: np.ndarray  # (na, G) float64 accumulators
+    acc_cnt: np.ndarray  # (na, G) valid/pass counts
+    acc_aux: np.ndarray  # (na, G) float min/max aux counts
+
+
 class _AggState:
     """Python-owned state of one fused aggregation: the group hash
     table, per-group key identity + first-occurrence values, and the
@@ -473,31 +511,306 @@ class _AggState:
                 self._grow()
         return True
 
+    def partials(self, copy: bool = True) -> AggPartials:
+        """Snapshot the carried chunk state as :class:`AggPartials` —
+        the stable public hook (the per-chunk partials used to be
+        folded away inside the sweep; the sidecar capture and the
+        metadata merge consume this instead of re-deriving the layout).
+        ``copy=False`` returns VIEWS of the live state for callers that
+        discard the state immediately (the fused finalize) — never hold
+        such a snapshot across another ``accumulate``."""
 
-def _finalize(state: _AggState) -> ColumnarBatch:
-    """Assemble the output batch from the partials — the exact
+        def sl(a):
+            s = a[:, : self.n_groups]
+            return s.copy() if copy else s
+
+        return AggPartials(
+            n_groups=self.n_groups,
+            rows_scanned=self.rows_scanned,
+            rows_passed=self.rows_passed,
+            g_reps=sl(self.g_reps),
+            g_nulls=sl(self.g_nulls),
+            g_kvals=sl(self.g_kvals),
+            g_kvalid=sl(self.g_kvalid),
+            key_has_validity=tuple(self.key_has_validity),
+            acc_i=sl(self.acc_i),
+            acc_f=sl(self.acc_f),
+            acc_cnt=sl(self.acc_cnt),
+            acc_aux=sl(self.acc_aux),
+        )
+
+
+#: public name of the chunk-state carrier (kept underscore-free for the
+#: capture/metadata consumers; the historical private name stays bound)
+AggState = _AggState
+
+
+def partials_from_batch(
+    plan, batch: ColumnarBatch, rows_scanned: Optional[int] = None
+) -> Optional[AggPartials]:
+    """Numpy twin of the kernel chunk sweep at the PARTIALS level: one
+    already-filtered batch -> :class:`AggPartials`, bit-identical to
+    ``AggState.accumulate(...).partials()`` over the same rows (wrapped
+    int sums, +0.0-for-null float sums, replace-on-equal min/max, clean/
+    NaN aux counts, first-occurrence key values). Shared by the sidecar
+    capture (``indexes/aggindex.py`` runs it per row group at build
+    time) and the metadata plane's kernel-less boundary chunks. ``plan``
+    only needs ``group_by`` + ``agg_ops`` (a full FusedAggPlan or the
+    capture's lightweight spec). None when a column falls outside the
+    fused 8-byte type set."""
+    from hyperspace_tpu.execution.aggregate_exec import _factorize
+
+    n = batch.num_rows
+    gid, first, G = _factorize(batch, list(plan.group_by))
+    nk = len(plan.group_by)
+    na = len(plan.agg_ops)
+    g_reps = np.zeros((nk, G), dtype=np.int64)
+    g_nulls = np.zeros((nk, G), dtype=np.uint8)
+    g_kvals = np.zeros((nk, G), dtype=np.int64)
+    g_kvalid = np.ones((nk, G), dtype=np.uint8)
+    khv = []
+    for j, name in enumerate(plan.group_by):
+        col = batch.column(name)
+        arr = _col_arr_8b(col)
+        if arr is None:
+            return None
+        g_reps[j] = col.key_rep()[first]
+        nm = col.null_mask
+        if nm is not None:
+            g_nulls[j] = nm[first].astype(np.uint8)
+        g_kvals[j] = arr.view(np.int64)[first]
+        if col.validity is not None:
+            g_kvalid[j] = col.validity[first].astype(np.uint8)
+        khv.append(col.validity is not None)
+    acc_i = np.zeros((na, G), dtype=np.int64)
+    acc_f = np.zeros((na, G), dtype=np.float64)
+    acc_cnt = np.zeros((na, G), dtype=np.int64)
+    acc_aux = np.zeros((na, G), dtype=np.int64)
+    for a, (op, cname) in enumerate(plan.agg_ops):
+        if op == _OP_COUNT_STAR:
+            acc_cnt[a] = np.bincount(gid, minlength=G)[:G]
+            continue
+        col = batch.column(cname)
+        nm = col.null_mask
+        valid = np.ones(n, dtype=bool) if nm is None else ~nm
+        acc_cnt[a] = np.bincount(gid[valid], minlength=G)[:G]
+        if op == _OP_COUNT_COL:
+            continue
+        arr = _col_arr_8b(col)
+        if arr is None:
+            return None
+        if op == _OP_SUM_I64:
+            v = np.where(valid, arr.view(np.int64), np.int64(0))
+            s = np.zeros(G, dtype=np.int64)
+            np.add.at(s, gid, v)
+            acc_i[a] = s
+        elif op == _OP_SUM_F64:
+            v = np.where(valid, arr, np.float64(0.0))
+            s = np.zeros(G, dtype=np.float64)
+            np.add.at(s, gid, v)
+            acc_f[a] = s
+        elif op in (_OP_MIN_I64, _OP_MAX_I64):
+            iv = arr.view(np.int64)
+            if op == _OP_MIN_I64:
+                fill = np.iinfo(np.int64).max
+                red = np.full(G, fill, dtype=np.int64)
+                np.minimum.at(red, gid, np.where(valid, iv, fill))
+            else:
+                fill = np.iinfo(np.int64).min
+                red = np.full(G, fill, dtype=np.int64)
+                np.maximum.at(red, gid, np.where(valid, iv, fill))
+            acc_i[a] = red
+        else:  # _OP_MIN_F64 / _OP_MAX_F64
+            isn = np.isnan(arr)
+            clean = valid & ~isn
+            if op == _OP_MIN_F64:
+                red = np.full(G, np.inf, dtype=np.float64)
+                np.minimum.at(red, gid, np.where(clean, arr, np.inf))
+                acc_aux[a] = np.bincount(gid[clean], minlength=G)[:G]
+            else:
+                red = np.full(G, -np.inf, dtype=np.float64)
+                np.maximum.at(red, gid, np.where(clean, arr, -np.inf))
+                acc_aux[a] = np.bincount(gid[valid & isn], minlength=G)[:G]
+            acc_f[a] = red
+    return AggPartials(
+        n_groups=G,
+        rows_scanned=n if rows_scanned is None else rows_scanned,
+        rows_passed=n,
+        g_reps=g_reps,
+        g_nulls=g_nulls,
+        g_kvals=g_kvals,
+        g_kvalid=g_kvalid,
+        key_has_validity=tuple(khv),
+        acc_i=acc_i,
+        acc_f=acc_f,
+        acc_cnt=acc_cnt,
+        acc_aux=acc_aux,
+    )
+
+
+class PartialsAccumulator:
+    """Order-preserving fold of :class:`AggPartials` snapshots into one
+    group table — the serve-time merge point where sidecar-persisted
+    partials and scanned boundary-chunk partials meet.
+
+    Folding is bit-exact ONLY for the merge-associative ops — COUNT,
+    int SUM/AVG (wraps mod 2^64), MIN/MAX (``np.minimum``/``maximum``
+    binary semantics, so replace-on-equal folds like the row sweep) —
+    which is exactly the set the metadata plane admits; float SUM is
+    order-sensitive and never reaches a fold (``try_metadata_aggregate``
+    declines it up front). Callers must fold in the interpreted chain's
+    row order (file order, row-group order within a file): first-
+    occurrence group key values and equal-value min/max bit patterns
+    depend on it."""
+
+    _INIT_CAP = 64
+
+    def __init__(self, plan):
+        self.plan = plan
+        self._nk = len(plan.group_by)
+        self._na = len(plan.agg_ops)
+        self._slots: Dict[tuple, int] = {}
+        self._n = 0
+        self._alloc(self._INIT_CAP)
+        self.rows_scanned = 0
+        self.rows_passed = 0
+        self.key_has_validity = [False] * self._nk
+        if not plan.group_by:
+            # ungrouped aggregation always yields exactly one global
+            # group, even over zero folded rows (COUNT 0 / NULL min)
+            self._slots[()] = 0
+            self._n = 1
+
+    def _alloc(self, cap: int) -> None:
+        nk, na = self._nk, self._na
+        n = self._n
+        old = getattr(self, "_g_reps", None)
+        self._cap = cap
+        for name, dt, fill in (
+            ("_g_reps", np.int64, 0),
+            ("_g_nulls", np.uint8, 0),
+            ("_g_kvals", np.int64, 0),
+            ("_g_kvalid", np.uint8, 1),
+        ):
+            arr = np.full((nk, cap), fill, dtype=dt)
+            if old is not None:
+                arr[:, :n] = getattr(self, name)[:, :n]
+            setattr(self, name, arr)
+        acc_i = np.zeros((na, cap), dtype=np.int64)
+        acc_f = np.zeros((na, cap), dtype=np.float64)
+        acc_cnt = np.zeros((na, cap), dtype=np.int64)
+        acc_aux = np.zeros((na, cap), dtype=np.int64)
+        for a, (op, _c) in enumerate(self.plan.agg_ops):
+            if op == _OP_MIN_I64:
+                acc_i[a] = np.iinfo(np.int64).max
+            elif op == _OP_MAX_I64:
+                acc_i[a] = np.iinfo(np.int64).min
+            elif op == _OP_MIN_F64:
+                acc_f[a] = np.inf
+            elif op == _OP_MAX_F64:
+                acc_f[a] = -np.inf
+        if old is not None:
+            acc_i[:, :n] = self._acc_i[:, :n]
+            acc_f[:, :n] = self._acc_f[:, :n]
+            acc_cnt[:, :n] = self._acc_cnt[:, :n]
+            acc_aux[:, :n] = self._acc_aux[:, :n]
+        self._acc_i, self._acc_f = acc_i, acc_f
+        self._acc_cnt, self._acc_aux = acc_cnt, acc_aux
+
+    def fold(self, p: Optional[AggPartials]) -> None:
+        if p is None:
+            return
+        self.rows_scanned += p.rows_scanned
+        self.rows_passed += p.rows_passed
+        for j, hv in enumerate(p.key_has_validity):
+            self.key_has_validity[j] |= hv
+        G = p.n_groups
+        if G == 0:
+            return
+        while self._n + G > self._cap:
+            self._alloc(self._cap * 4)
+        # slot resolution is the one per-group Python loop; the
+        # accumulation below is vectorized — safe with direct indexed
+        # ops because group keys WITHIN one snapshot are distinct, so
+        # ``idx`` never repeats a destination
+        nk = self._nk
+        idx = np.empty(G, dtype=np.int64)
+        for g in range(G):
+            key = tuple(
+                (int(p.g_reps[j, g]), int(p.g_nulls[j, g])) for j in range(nk)
+            )
+            gi = self._slots.get(key)
+            if gi is None:
+                gi = self._n
+                self._slots[key] = gi
+                self._n += 1
+                for j in range(nk):
+                    self._g_reps[j, gi] = p.g_reps[j, g]
+                    self._g_nulls[j, gi] = p.g_nulls[j, g]
+                    self._g_kvals[j, gi] = p.g_kvals[j, g]
+                    self._g_kvalid[j, gi] = p.g_kvalid[j, g]
+            idx[g] = gi
+        for a, (op, _c) in enumerate(self.plan.agg_ops):
+            self._acc_cnt[a][idx] += p.acc_cnt[a]
+            if op == _OP_SUM_I64:
+                # int64 two's-complement addition wraps like the
+                # kernel's uint64 accumulate
+                self._acc_i[a][idx] += p.acc_i[a]
+            elif op == _OP_SUM_F64:
+                self._acc_f[a][idx] += p.acc_f[a]
+            elif op == _OP_MIN_I64:
+                self._acc_i[a][idx] = np.minimum(self._acc_i[a][idx], p.acc_i[a])
+            elif op == _OP_MAX_I64:
+                self._acc_i[a][idx] = np.maximum(self._acc_i[a][idx], p.acc_i[a])
+            elif op == _OP_MIN_F64:
+                self._acc_f[a][idx] = np.minimum(self._acc_f[a][idx], p.acc_f[a])
+                self._acc_aux[a][idx] += p.acc_aux[a]
+            elif op == _OP_MAX_F64:
+                self._acc_f[a][idx] = np.maximum(self._acc_f[a][idx], p.acc_f[a])
+                self._acc_aux[a][idx] += p.acc_aux[a]
+
+    def snapshot(self) -> AggPartials:
+        G = self._n
+        return AggPartials(
+            n_groups=G,
+            rows_scanned=self.rows_scanned,
+            rows_passed=self.rows_passed,
+            g_reps=self._g_reps[:, :G].copy(),
+            g_nulls=self._g_nulls[:, :G].copy(),
+            g_kvals=self._g_kvals[:, :G].copy(),
+            g_kvalid=self._g_kvalid[:, :G].copy(),
+            key_has_validity=tuple(self.key_has_validity),
+            acc_i=self._acc_i[:, :G].copy(),
+            acc_f=self._acc_f[:, :G].copy(),
+            acc_cnt=self._acc_cnt[:, :G].copy(),
+            acc_aux=self._acc_aux[:, :G].copy(),
+        )
+
+
+def finalize_partials(plan, pt: AggPartials) -> ColumnarBatch:
+    """Assemble the output batch from a partials snapshot — the exact
     post-processing of ``aggregate_exec.execute_aggregate`` (shared
     ``finalize_*`` helpers), with groups ordered like ``_factorize``:
-    ascending lexicographic key-rep planes (rep major, null plane
-    minor per key)."""
+    ascending lexicographic key-rep planes (rep major, null plane minor
+    per key). The ONE finalization for the fused sweep, the metadata
+    merge and the capture round-trip tests."""
     from hyperspace_tpu.execution import aggregate_exec as AE
 
-    plan = state.plan
-    G = state.n_groups
+    G = pt.n_groups
     out: Dict[str, Column] = {}
     if plan.group_by:
         planes: List[np.ndarray] = []
         for j in range(len(plan.group_by)):
-            planes.append(state.g_reps[j, :G])
-            planes.append(state.g_nulls[j, :G].astype(np.int64))
+            planes.append(pt.g_reps[j])
+            planes.append(pt.g_nulls[j].astype(np.int64))
         # np.lexsort keys are minor→major; planes are major→minor
         order = np.lexsort(planes[::-1])
         for j, name in enumerate(plan.group_by):
-            raw = state.g_kvals[j, :G][order]
+            raw = pt.g_kvals[j][order]
             vals = raw.view(np.float64) if plan.key_f64[j] else raw
             validity = (
-                state.g_kvalid[j, :G][order].astype(bool)
-                if state.key_has_validity[j]
+                pt.g_kvalid[j][order].astype(bool)
+                if pt.key_has_validity[j]
                 else None
             )
             out[name] = Column(
@@ -508,37 +821,43 @@ def _finalize(state: _AggState) -> ColumnarBatch:
     for a, (spec, (op, _c), out_type) in enumerate(
         zip(plan.aggs, plan.agg_ops, plan.out_types)
     ):
-        cnt = state.acc_cnt[a, :G][order]
+        cnt = pt.acc_cnt[a][order]
         if op in (_OP_COUNT_STAR, _OP_COUNT_COL):
             out[spec.name] = AE.finalize_count(out_type, cnt)
         elif op in (_OP_SUM_I64, _OP_SUM_F64):
-            sums = (
-                state.acc_i if op == _OP_SUM_I64 else state.acc_f
-            )[a, :G][order]
+            sums = (pt.acc_i if op == _OP_SUM_I64 else pt.acc_f)[a][order]
             if spec.func == "avg":
                 out[spec.name] = AE.finalize_avg(out_type, sums, cnt)
             else:
                 out[spec.name] = AE.finalize_sum(out_type, sums, cnt)
         elif op in (_OP_MIN_I64, _OP_MAX_I64):
-            red = state.acc_i[a, :G][order]
+            red = pt.acc_i[a][order]
             out[spec.name] = AE.finalize_minmax(
                 out_type, red, cnt, np.dtype(np.int64)
             )
         elif op == _OP_MIN_F64:
-            acc = state.acc_f[a, :G][order]
-            has_clean = state.acc_aux[a, :G][order] > 0
+            acc = pt.acc_f[a][order]
+            has_clean = pt.acc_aux[a][order] > 0
             red = np.where(has_clean, acc, np.float64(np.nan))
             out[spec.name] = AE.finalize_minmax(
                 out_type, red, cnt, np.dtype(np.float64)
             )
         else:  # _OP_MAX_F64
-            acc = state.acc_f[a, :G][order]
-            has_nan = state.acc_aux[a, :G][order] > 0
+            acc = pt.acc_f[a][order]
+            has_nan = pt.acc_aux[a][order] > 0
             red = np.where(has_nan, np.float64(np.nan), acc)
             out[spec.name] = AE.finalize_minmax(
                 out_type, red, cnt, np.dtype(np.float64)
             )
     return ColumnarBatch(out)
+
+
+def _finalize(state: _AggState) -> ColumnarBatch:
+    """The fused sweep's finalization: snapshot the carried state and run
+    the shared partials finalization (views, not copies — the state is
+    discarded right after, and finalize_partials reorders into fresh
+    arrays anyway)."""
+    return finalize_partials(state.plan, state.partials(copy=False))
 
 
 def kernel_filter_aggregate(
@@ -808,4 +1127,215 @@ def _run_chunked(fplan: FusedAggPlan, rel) -> Optional[ColumnarBatch]:
             return None  # executor falls back to the interpreted chain
     out = _finalize(state)
     last_fused_stats = _agg_stats(state, t0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Metadata plane: answer point aggregates from persisted partials
+# (docs/agg-serve.md; sidecar capture/assembly in indexes/aggindex.py)
+# ---------------------------------------------------------------------------
+
+
+def agg_plane_on(session) -> bool:
+    """``hyperspace.index.agg.enabled`` (default on). Like the fused
+    pass, a pure serving substitution with identical output, so it also
+    applies to sessionless execution."""
+    if session is None:
+        return C.INDEX_AGG_ENABLED_DEFAULT
+    return session.conf.index_agg_enabled
+
+
+#: ops whose partials fold associatively bit-for-bit (see
+#: PartialsAccumulator): float SUM/AVG is excluded — merging per-row-
+#: group float sums would reassociate vs the row-sequential chain
+_METADATA_MERGE_OPS = frozenset(
+    {
+        _OP_COUNT_STAR,
+        _OP_COUNT_COL,
+        _OP_SUM_I64,
+        _OP_MIN_I64,
+        _OP_MAX_I64,
+        _OP_MIN_F64,
+        _OP_MAX_F64,
+    }
+)
+
+
+def _chunk_partials(fplan: FusedAggPlan, batch: ColumnarBatch):
+    """Partials of one boundary chunk: the fused kernel when available
+    (same sweep the fused pass runs), else the numpy twin over the
+    masked batch — bit-identical either way (partials-level twin
+    contract, differential-tested in tests/test_agg_index.py)."""
+    from hyperspace_tpu import native
+
+    if fplan.terms and batch.num_rows and native.load(wait=False) is not None:
+        state = _AggState(fplan)
+        if state.accumulate(batch):
+            return state.partials()
+    if fplan.terms:
+        from hyperspace_tpu.ops.filter import range_mask_numpy
+
+        fb = batch.filter(range_mask_numpy(batch, fplan.terms))
+    else:
+        fb = batch
+    return partials_from_batch(fplan, fb, rows_scanned=batch.num_rows)
+
+
+def try_metadata_aggregate(plan: Aggregate, session) -> Optional[ColumnarBatch]:
+    """Serve ``Aggregate(…, [Project] [Filter(cond,)] Scan)`` over a
+    clean index scan from the persisted partial-aggregate sidecars
+    (``_aggstate.json``): row groups whose zone provably satisfies EVERY
+    conjunct fold their stored partials without opening a single parquet
+    file; boundary row groups are scanned through the fused kernel (or
+    its numpy twin) for just those chunks; everything merges through
+    :class:`PartialsAccumulator` + :func:`finalize_partials`, so the
+    result stays bit-identical to the interpreted chain. None = any gate
+    failed; the caller runs the fused pass / interpreted chain instead
+    (bit-identical whichever path answers)."""
+    global last_aggplane_stats
+    if not agg_plane_on(session):
+        return None
+    node = plan.child
+    while isinstance(node, Project):
+        node = node.child
+    if isinstance(node, Filter) and isinstance(node.child, Scan):
+        cond, scan = node.condition, node.child
+    elif isinstance(node, Scan):
+        cond, scan = None, node
+    else:
+        return None
+    if len(plan.group_by) > 1:
+        return None  # grouped partials are captured per single key column
+    from hyperspace_tpu.execution import executor as X
+
+    if cond is not None:
+        pruned = X._bucket_pruned_scan(scan, cond)
+        pruned = X._range_pruned_scan(pruned, cond, session)
+        if not isinstance(pruned, Scan):
+            return None
+    else:
+        pruned = scan
+    rel = pruned.relation
+    if not X._cacheable_scan(rel):
+        return None
+    t0 = time.perf_counter()
+    child_schema = dict(rel.schema)
+    child_schema.update(plan.child.schema())
+    if cond is None:
+        ivs: Dict[str, Any] = {}
+        fplan = _lower_from_terms(
+            (), plan.group_by, plan.aggs, child_schema, rel.column_names
+        )
+    else:
+        from hyperspace_tpu.indexes import zonemaps
+
+        # STRICT lowering: full-coverage classification is sound only
+        # when the intervals ARE the predicate (IN hulls, OR trees, !=
+        # etc. abstain and the whole plane declines)
+        ivs = zonemaps.predicate_intervals_complete(cond, rel.schema)
+        if ivs is None:
+            return None
+        fplan = _lower_fused_agg(
+            cond, plan.group_by, plan.aggs, child_schema, rel.column_names
+        )
+    if fplan is None:
+        return None
+    for op, _c in fplan.agg_ops:
+        if op not in _METADATA_MERGE_OPS:
+            return None
+    from hyperspace_tpu.indexes import aggindex
+
+    key = plan.group_by[0] if plan.group_by else None
+    data = aggindex.agg_data_for(
+        rel,
+        X._serve_cache(session),
+        session.conf if session is not None else None,
+        key,
+    )
+    if data is None:
+        return None
+    cells = aggindex.classify_row_groups(data, rel, ivs, key, fplan)
+    if cells is None:
+        return None
+    n_full = sum(1 for _f, _g, kind in cells if kind == "full")
+    if n_full == 0:
+        # nothing answerable from metadata: no win over the fused pass,
+        # and engaging would only shadow its telemetry
+        return None
+    cols = list(fplan.read_cols)
+    partial_cells = [
+        (i, fi, gi)
+        for i, (fi, gi, kind) in enumerate(cells)
+        if kind == "partial"
+    ]
+    cache = X._serve_cache(session)
+    if partial_cells and cache is not None:
+        # serve-server mode with a WARM decoded scan: the fused pass
+        # serves boundary rows straight from RAM — re-reading them from
+        # parquet here would make partial coverage slower than the path
+        # it preempts. (A cold cache still favors metadata + boundary
+        # disk reads; and full coverage never reads at all.)
+        from hyperspace_tpu.execution.serve_cache import file_fingerprint
+
+        fp = file_fingerprint(rel.files)
+        if fp is not None:
+            entry = cache.peek(("scan", fp))
+            if entry is not None and entry.batch_for(cols) is not None:
+                return None
+    # boundary chunk reads overlap the metadata folds on the scan pool;
+    # folding stays strictly in (file, row-group) order — the
+    # interpreted chain's row order (see PartialsAccumulator)
+    from hyperspace_tpu.io.scan import scan_pool
+
+    reads = {}
+    if len(partial_cells) > 1:
+        for i, fi, gi in partial_cells:
+            reads[i] = scan_pool().submit(
+                _read_chunk,
+                rel.files[fi],
+                None if gi is None else [gi],
+                cols,
+            )
+    acc = PartialsAccumulator(fplan)
+    rows_read = 0
+    n_empty = n_partial = 0
+    for i, (fi, gi, kind) in enumerate(cells):
+        if kind == "empty":
+            n_empty += 1
+            continue
+        if kind == "full":
+            acc.fold(aggindex.rg_partials(data, fi, gi, fplan, key))
+            continue
+        n_partial += 1
+        fut = reads.get(i)
+        table = (
+            fut.result()
+            if fut is not None
+            else _read_chunk(
+                rel.files[fi], None if gi is None else [gi], cols
+            )
+        )
+        batch = ColumnarBatch.from_arrow(table)
+        rows_read += batch.num_rows
+        p = _chunk_partials(fplan, batch)
+        if p is None:
+            # column outside the fused set mid-stream: bail to the
+            # interpreted chain, releasing not-yet-started reads so the
+            # pool doesn't keep scanning data nobody will consume
+            for j, fut2 in reads.items():
+                if j > i:
+                    fut2.cancel()
+            return None
+        acc.fold(p)
+    out = finalize_partials(fplan, acc.snapshot())
+    last_aggplane_stats = {
+        "mode": "agg_metadata",
+        "row_groups_total": len(cells),
+        "row_groups_metadata": n_full,
+        "row_groups_empty": n_empty,
+        "row_groups_scanned": n_partial,
+        "rows_scanned": rows_read,
+        "groups": int(out.num_rows),
+        "wall_s": time.perf_counter() - t0,
+    }
     return out

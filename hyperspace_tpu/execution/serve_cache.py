@@ -34,7 +34,11 @@ path behaves exactly as before. What gets cached (see
   (``indexes/zonemaps.py``);
 * ``("fusedplan", fp, …)`` — compiled fused-pipeline lowerings
   (``execution/pipeline_compiler.FusedAggPlan``): the symbolic
-  Filter→Aggregate lowering reused across serves of one index version.
+  Filter→Aggregate lowering reused across serves of one index version;
+* ``("aggstate", fp)`` — assembled aggregate-plane partials
+  (``indexes/aggindex.AggData``): the decoded per-row-group partial-
+  aggregate state the metadata lowering folds instead of reading rows
+  (docs/agg-serve.md).
 """
 
 from __future__ import annotations
@@ -176,7 +180,7 @@ class ServeCache:
     def evict_kind(self, kind: str) -> int:
         """Drop every entry of one kind (keys are ``(kind, …)`` tuples:
         "scan" / "bucketed" / "joinside" / "delta" / "zonemap" /
-        "fusedplan"). Returns the number evicted. Operational tooling:
+        "fusedplan" / "aggstate"). Returns the number evicted. Operational tooling:
         lets a serve process (or bench) shed one class of state — e.g.
         keep the prepared hybrid delta but force joinside
         re-preparation, or drop compiled fused-pipeline plans after a

@@ -204,6 +204,41 @@ def predicate_intervals(
     return out
 
 
+def predicate_intervals_complete(
+    cond: E.Expr, schema: Dict[str, pa.DataType]
+) -> Optional[Dict[str, ColInterval]]:
+    """:func:`predicate_intervals`, but None unless EVERY top-level
+    conjunct lowered into an interval on a known column — for consumers
+    whose soundness needs the intervals to BE the predicate, not merely
+    bound it (the aggregate plane's full-coverage classification,
+    ``indexes/aggindex.py``: a row group may be answered from persisted
+    partials only when *all* of its rows provably satisfy the whole
+    conjunction).
+
+    Deliberately stricter than the pruning lowering: ``IN`` lists abstain
+    here even though pruning accepts their [min, max] hull — the hull is
+    a superset of the point set, sound for keep/drop decisions but NOT
+    for "every row matches". Same for ``!=``, OR trees, IS NULL and any
+    non-lowerable conjunct."""
+    cols = {c.lower(): c for c in schema}
+    out: Dict[str, ColInterval] = {}
+    for cj in E.split_conjuncts(cond):
+        norm = E.normalize_comparison(cj)
+        if norm is None:
+            return None
+        op, name, lit = norm
+        if op == "!=":
+            return None
+        col = cols.get(name.lower())
+        if col is None:
+            return None
+        iv = interval_for(op, lit, schema[col])
+        if iv is None:
+            return None
+        out[col] = _merge(out[col], iv) if col in out else iv
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Comparable-domain conversion (directed rounding — see module docstring)
 # ---------------------------------------------------------------------------

@@ -38,6 +38,12 @@ def _all_rules() -> List[HyperspaceRule]:
         rules.append(ApplyDataSkippingIndex())
     except ImportError:
         pass
+    try:
+        from hyperspace_tpu.rules.agg_rule import AggregateIndexRule
+
+        rules.append(AggregateIndexRule())
+    except ImportError:
+        pass
     rules.append(NoOpRule())
     return rules
 

@@ -103,6 +103,7 @@ CRASH_POINTS = (
     "after_data_write",
     "after_end_log",
     "mid_vacuum_delete",
+    "mid_sidecar_publish",
 )
 
 #: ``exit``-mode crash status — distinctive, so a subprocess test can tell

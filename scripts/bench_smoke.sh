@@ -81,6 +81,23 @@ for row in ("filter_agg", "grouped_agg"):
 assert d["grouped_agg"]["stats"]["groups"] > 1, d["grouped_agg"]
 print("bench_smoke: fused pipeline ok:", d["filter_agg"]["stats"],
       d["grouped_agg"]["stats"], file=sys.stderr)
+# the aggregate index plane (docs/agg-serve.md) must have answered the
+# fully-covered grouped point aggregate FROM THE SIDECAR: metadata path
+# fired, every selected row group folded from persisted partials, ZERO
+# parquet rows read; and the approximate plane must have produced a
+# bounded estimate whose 95% interval contained the exact answer
+am = d["agg_metadata"]
+assert am["metadata_ran"], f"metadata plane did not run: {am}"
+assert am["stats"]["row_groups_scanned"] == 0, am
+assert am["stats"]["rows_scanned"] == 0, am
+assert am["stats"]["row_groups_metadata"] == am["stats"]["row_groups_total"], am
+assert am["stats"]["groups"] > 1, am
+ap = d["agg_approx"]
+assert ap["count_bound_held"] and ap["sum_bound_held"], ap
+assert ap["stats"]["sample_rows"] > 0, ap
+assert ap["stats"]["sample_rows"] < ap["stats"]["population_rows"], ap
+print("bench_smoke: aggregate plane ok:", am["stats"], ap["stats"],
+      file=sys.stderr)
 # the concurrent serve frontend must have run its contention ladder
 # (incl. the 8- and 64-client rungs) with the cache budget holding, and
 # the fault-injection rung must have fired EVERY injection point at

@@ -287,7 +287,8 @@ def _create_end_to_end(root: str) -> tuple:
     on_disk = sorted(
         os.path.join(data_dirs[0], n)
         for n in os.listdir(data_dirs[0])
-        if n.endswith(".parquet")
+        # hidden-path filter: sidecars (_aggsample.parquet) are not data
+        if n.endswith(".parquet") and not n.startswith(("_", "."))
     )
     assert [os.path.basename(f) for f in content_files] == [
         os.path.basename(f) for f in on_disk

@@ -559,6 +559,48 @@ class TestCrashMatrix:
         assert_serve_matches_source(s, src)
 
 
+class TestSidecarPublishCrash:
+    """mid_sidecar_publish: a crash between computing the aggregate-plane
+    sidecar and its atomic replace (indexes/aggindex.capture_index_dir).
+    SimulatedCrash is a BaseException, so it must propagate through
+    capture_safely's Exception swallow, fail the surrounding op(), and
+    recovery must roll the action back; the retried action completes with
+    a COMPLETE sidecar (the publish is atomic — never a torn one)."""
+
+    def test_create_crashed_at_sidecar_publish_recovers(self, env):
+        import json
+
+        from hyperspace_tpu.indexes import aggindex
+
+        s, hs, src = env
+        df = s.read.parquet(src)
+        cfg = CoveringIndexConfig("idx", ["clicks"], ["query"])
+        log_mgr, _ = s.index_manager._managers("idx")
+        faults.set_crash("mid_sidecar_publish", "raise;match=_aggstate")
+        with pytest.raises(SimulatedCrash):
+            hs.create_index(df, cfg)
+        assert faults.stats().get("crash.mid_sidecar_publish", 0) == 1
+        assert log_mgr.get_latest_log().state not in States.STABLE_STATES
+        wait_lease()
+        rep = hs.recover("idx")
+        assert rep["rolled_back"]
+        # retry: completes, and the published sidecar parses (atomic —
+        # the crash could only ever leave it absent, never torn)
+        hs.create_index(s.read.parquet(src), cfg)
+        tip = log_mgr.get_latest_log()
+        assert tip.state == States.ACTIVE
+        found = []
+        for root, _dirs, names in os.walk(log_mgr.index_path):
+            for n in names:
+                if n == aggindex.SIDECAR_NAME:
+                    found.append(os.path.join(root, n))
+        assert found, "sidecar missing after the retried create"
+        for p in found:
+            with open(p, "r", encoding="utf-8") as fh:
+                assert json.load(fh).get("files")
+        assert_serve_matches_source(s, src)
+
+
 # ---------------------------------------------------------------------------
 # Cancel: direct coverage (satellite)
 # ---------------------------------------------------------------------------

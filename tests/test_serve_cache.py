@@ -747,7 +747,7 @@ class TestMemoryGovernor:
             try:
                 i = 0
                 while not stop.is_set():
-                    kind = ("scan", "joinside", "delta")[i % 3]
+                    kind = ("scan", "joinside", "delta", "aggstate")[i % 4]
                     c.put((kind, tag, i % 11), ("v", tag, i), 100 + (i % 7))
                     c.get((kind, tag, (i + 5) % 11))
                     c.peek((kind, tag, (i + 2) % 11))
@@ -777,6 +777,7 @@ class TestMemoryGovernor:
         while time.monotonic() < deadline:
             evicted += c.evict_kind("scan")
             c.evict_kind("delta")
+            c.evict_kind("aggstate")
         stop.set()
         for t in threads:
             t.join(30)
