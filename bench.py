@@ -603,6 +603,61 @@ def main() -> None:
             f"0 stranded / 0 orphans / 0 mismatches"
         )
 
+        # --- multi-process fleet ladder (serve/fleet.py, docs/fleet-
+        # serve.md): N REAL frontend processes over one lake, identical
+        # schedules from a barrier start — the horizontal twin of the
+        # 1/8/64-client ladder above. Each rung reports aggregate QPS,
+        # cross-process dedup (the single-flight that saved 256/512
+        # queries at one process must not regress to 0 at eight), and
+        # the two zeros bench_smoke.sh gates on: wrong answers and
+        # leaked pin files. The final rung is the chaos rung: kill -9
+        # one frontend mid-serve, survivors still bit-identical, the
+        # dead frontend's durable pins reaped at lease expiry.
+        from hyperspace_tpu.testing import fleet_harness as _fleet
+
+        fleet_procs = [
+            int(x)
+            for x in os.environ.get("HS_BENCH_FLEET", "2,4,8").split(",")
+            if x.strip()
+        ]
+        fleet_iters = int(os.environ.get("HS_BENCH_FLEET_ITERS", 8))
+        fleet_rows = int(os.environ.get("HS_BENCH_FLEET_ROWS", 50_000))
+        fleet_root = os.path.join(tmp, "fleet")
+        fleet_lake = _fleet.build_lake(fleet_root, rows=fleet_rows)
+        fleet_ladder = []
+        for np_ in fleet_procs:
+            row = _fleet.run_fleet(
+                os.path.join(fleet_root, f"rung{np_}"),
+                n_procs=np_,
+                iters=fleet_iters,
+                reuse_lake=fleet_lake,
+            )
+            assert row["wrong_answers"] == 0, row
+            assert row["leaked_pin_files"] == 0, row
+            assert row["cross_process_dedup"] > 0, row
+            fleet_ladder.append(row)
+            log(
+                f"fleet {np_} procs: {row['qps']} qps aggregate, p50 "
+                f"{row['p50_ms']}ms p99 {row['p99_ms']}ms, cross-process "
+                f"dedup {row['cross_process_dedup']}/{row['queries']}, "
+                f"0 wrong / 0 leaked pins"
+            )
+        fleet_chaos = _fleet.run_fleet(
+            os.path.join(fleet_root, "chaos"),
+            n_procs=max(fleet_procs) if fleet_procs else 2,
+            iters=fleet_iters,
+            kill_one=True,
+            reuse_lake=fleet_lake,
+        )
+        assert fleet_chaos["wrong_answers"] == 0, fleet_chaos
+        assert fleet_chaos["leaked_pin_files"] == 0, fleet_chaos
+        log(
+            f"fleet chaos (kill -9 one of {fleet_chaos['processes']}): "
+            f"{fleet_chaos['workers_reporting']} survivors, 0 wrong "
+            f"answers, 0 leaked pins, dedup "
+            f"{fleet_chaos['cross_process_dedup']}"
+        )
+
         session.conf.set(C.SERVE_CACHE_ENABLED, False)
         session.clear_serve_cache()  # later stages measure uncached paths;
         # keeping 200+MB resident would only add allocator/page pressure
@@ -1140,6 +1195,25 @@ def main() -> None:
                         join_raw["p50"] / join_cached["p50"], 3
                     ),
                     "serve_concurrency": serve_concurrency,
+                    "fleet_ladder": fleet_ladder,
+                    "fleet_chaos": fleet_chaos,
+                    "fleet_vs_64client_qps": round(
+                        fleet_ladder[-1]["qps"]
+                        / max(
+                            next(
+                                (
+                                    r["qps"]
+                                    for r in serve_concurrency
+                                    if r["clients"] == 64
+                                ),
+                                1.0,
+                            ),
+                            1e-9,
+                        ),
+                        3,
+                    )
+                    if fleet_ladder
+                    else None,
                     "chaos": chaos_summary,
                     "fault_injection": {
                         "fired": fault_fired,

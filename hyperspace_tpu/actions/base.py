@@ -226,6 +226,7 @@ class Action(abc.ABC):
             # thread dies with it, and the lease starts aging
             if heartbeat is not None:
                 heartbeat.stop()
+        self._publish_fleet_event(final)
         self._log_event(True)
 
     def _rendezvous_step(self, step: str, fn) -> int:
@@ -320,6 +321,9 @@ class Action(abc.ABC):
         finally:
             if heartbeat is not None:
                 heartbeat.stop()
+        # coordinator-only, like every other metadata-plane write: the
+        # fanout is plain file I/O, one publisher per action
+        self._publish_fleet_event(final)
         self._log_event(True)
 
     def _run_data_plane(self) -> None:
@@ -347,6 +351,23 @@ class Action(abc.ABC):
             self._log_event(False, str(e))
             raise
         self._log_event(True)
+
+    def _publish_fleet_event(self, entry: Optional[IndexLogEntry]) -> None:
+        """Fan the committed action out to peer serve frontends
+        (``serve/bus.py``; no-op outside fleet mode, never raises — the
+        commit already happened, a failed fanout only costs peers a lazy
+        re-read)."""
+        if not self.session.conf.fleet_enabled:
+            return
+        from hyperspace_tpu.serve import bus
+
+        bus.publish_action_event(
+            self.session,
+            getattr(self, "index_name", ""),
+            self.log_manager.index_path,
+            type(self).__name__,
+            entry,
+        )
 
     def _log_event(self, success: bool, message: str = "") -> None:
         ev = self.event(success, message)

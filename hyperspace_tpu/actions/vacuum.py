@@ -30,18 +30,38 @@ class VacuumAction(_StateFlipAction):
     required_state = States.DELETED
 
     def op(self) -> None:
-        # delete all index data (every version dir referenced or not)
+        # delete all index data (every version dir referenced or not) —
+        # except files under a LIVE pin (fleet mode: a query in another
+        # process pinned this snapshot while the index was still
+        # readable; its durable pin file outranks the vacuum until the
+        # lease expires, then orphan GC converges the leftovers)
         index_path = self.log_manager.index_path
-        from hyperspace_tpu.constants import HYPERSPACE_LOG_DIR
+        from hyperspace_tpu.constants import (
+            HYPERSPACE_LOG_DIR,
+            HYPERSPACE_PINS_DIR,
+        )
+        from hyperspace_tpu.metadata import recovery
 
+        pinned = recovery.all_pinned_files(index_path)
         for name in sorted(os.listdir(index_path)):
-            if name == HYPERSPACE_LOG_DIR:
+            if name == HYPERSPACE_LOG_DIR or name == HYPERSPACE_PINS_DIR:
                 continue
             # crash seam: a vacuum that dies between deletes leaves a
             # half-emptied index dir under a VACUUMING entry — recovery
             # rolls the log back to DELETED and a re-vacuum finishes
             faults.crash("mid_vacuum_delete", name)
-            file_utils.delete(os.path.join(index_path, name))
+            root = os.path.join(index_path, name)
+            leaves = (
+                [p for p, _s, _m in file_utils.list_leaf_files(root)]
+                if pinned
+                else []
+            )
+            if not any(p.replace("\\", "/") in pinned for p in leaves):
+                file_utils.delete(root)
+                continue
+            for p in leaves:
+                if p.replace("\\", "/") not in pinned:
+                    file_utils.delete(p)
 
     def log_entry(self) -> IndexLogEntry:
         entry = self._previous.copy()
@@ -65,9 +85,15 @@ class VacuumOutdatedAction(_StateFlipAction):
 
     def op(self) -> None:
         """Delete non-latest version dirs + unreferenced files in retained
-        dirs (VacuumOutdatedAction.op:86-120)."""
+        dirs (VacuumOutdatedAction.op:86-120). Files under a LIVE pin
+        (in-memory or a peer process's durable pin file, fleet mode) are
+        skipped — a serve that pinned the outgoing version finishes from
+        it, and orphan GC reclaims the leftovers once the lease expires."""
+        from hyperspace_tpu.metadata import recovery
         from hyperspace_tpu.utils import paths as path_utils
 
+        index_path = self.log_manager.index_path
+        pinned = recovery.all_pinned_files(index_path)
         live_files = set(self._previous.content.files)
         live_versions = {
             v
@@ -79,6 +105,17 @@ class VacuumOutdatedAction(_StateFlipAction):
         for version in self.data_manager.get_all_versions():
             if version not in live_versions:
                 faults.crash("mid_vacuum_delete", f"v__={version}")
+                root = self.data_manager.get_path(version)
+                leaves = (
+                    [p for p, _s, _m in file_utils.list_leaf_files(root)]
+                    if pinned
+                    else []
+                )
+                if any(p.replace("\\", "/") in pinned for p in leaves):
+                    for p in leaves:
+                        if p.replace("\\", "/") not in pinned:
+                            file_utils.delete(p)
+                    continue
                 self.data_manager.delete(version)
                 continue
             root = self.data_manager.get_path(version)
@@ -94,6 +131,8 @@ class VacuumOutdatedAction(_StateFlipAction):
                         file_utils.delete(path)
                     continue
                 if path not in live_files:
+                    if path.replace("\\", "/") in pinned:
+                        continue
                     faults.crash("mid_vacuum_delete", path)
                     file_utils.delete(path)
             # rewrite the aggregate-plane sidecars to drop entries for

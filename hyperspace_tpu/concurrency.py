@@ -192,6 +192,28 @@ SHARED_STATE: Dict[str, Tuple[str, str, str]] = {
         "monotonic pin-token counter incremented only under the pins "
         "lock",
     ),
+    "hyperspace_tpu.metadata.recovery._durable_pins": (
+        "hyperspace_tpu.metadata.recovery._pins_lock",
+        "guarded",
+        "durable-pin renewal map (token -> pin files) consulted by the "
+        "heartbeat sweep; record/release/snapshot all hold the pins "
+        "lock, pin-file I/O happens outside it",
+    ),
+    "hyperspace_tpu.metadata.recovery._pin_heartbeat": (
+        "hyperspace_tpu.metadata.recovery._pins_lock",
+        "guarded-writes",
+        "singleton renewal thread published by one rebind under the "
+        "pins lock; the unguarded read sees None or the started "
+        "heartbeat, never a torn value",
+    ),
+    # -- fleet fanout bus (serve/bus.py) -------------------------------------
+    "hyperspace_tpu.serve.bus._seq": (
+        "hyperspace_tpu.serve.bus._seq_lock",
+        "guarded",
+        "process-wide bus event sequence: every publisher (frontends, "
+        "the lifecycle-action hook) increments under the one lock so "
+        "same-millisecond publishes cannot collide on a file name",
+    ),
     # -- fault injection (testing/faults.py) ---------------------------------
     "hyperspace_tpu.testing.faults._crash_active": (
         "hyperspace_tpu.testing.faults._lock",

@@ -344,6 +344,88 @@ class Config:
             ),
         )
 
+    # -- replicated serve fleet (serve/fleet.py, serve/bus.py) ---------------
+    @property
+    def fleet_enabled(self) -> bool:
+        """Fleet mode: durable cross-process pins, index-version fanout
+        bus, cross-process single-flight (docs/fleet-serve.md)."""
+        return self.get_bool(C.FLEET_ENABLED, C.FLEET_ENABLED_DEFAULT)
+
+    @property
+    def fleet_pin_lease_ms(self) -> int:
+        return max(
+            1, self.get_int(C.FLEET_PIN_LEASE_MS, C.FLEET_PIN_LEASE_MS_DEFAULT)
+        )
+
+    @property
+    def fleet_bus_poll_ms(self) -> int:
+        return max(
+            1, self.get_int(C.FLEET_BUS_POLL_MS, C.FLEET_BUS_POLL_MS_DEFAULT)
+        )
+
+    @property
+    def fleet_bus_retain_ms(self) -> int:
+        return max(
+            0,
+            self.get_int(C.FLEET_BUS_RETAIN_MS, C.FLEET_BUS_RETAIN_MS_DEFAULT),
+        )
+
+    @property
+    def fleet_singleflight_enabled(self) -> bool:
+        return self.get_bool(
+            C.FLEET_SINGLEFLIGHT_ENABLED, C.FLEET_SINGLEFLIGHT_ENABLED_DEFAULT
+        )
+
+    @property
+    def fleet_singleflight_wait_ms(self) -> int:
+        return max(
+            0,
+            self.get_int(
+                C.FLEET_SINGLEFLIGHT_WAIT_MS,
+                C.FLEET_SINGLEFLIGHT_WAIT_MS_DEFAULT,
+            ),
+        )
+
+    @property
+    def fleet_singleflight_claim_ms(self) -> int:
+        return max(
+            1,
+            self.get_int(
+                C.FLEET_SINGLEFLIGHT_CLAIM_MS,
+                C.FLEET_SINGLEFLIGHT_CLAIM_MS_DEFAULT,
+            ),
+        )
+
+    @property
+    def fleet_spool_max_bytes(self) -> int:
+        return max(
+            0,
+            self.get_int(
+                C.FLEET_SPOOL_MAX_BYTES, C.FLEET_SPOOL_MAX_BYTES_DEFAULT
+            ),
+        )
+
+    @property
+    def fleet_slo_classes(self) -> dict:
+        """``{class name: (max_concurrency, max_queue_depth)}`` from the
+        ``hyperspace.fleet.class.<name>.{maxConcurrency,maxQueueDepth}``
+        prefix family (0 = unlimited for either bound)."""
+        out: dict = {}
+        prefix = C.FLEET_CLASS_KEY_PREFIX
+        for key, value in self.prefixed(prefix).items():
+            name, _, attr = key[len(prefix):].rpartition(".")
+            if not name:
+                continue
+            caps = out.setdefault(name, [0, 0])
+            try:
+                if attr == "maxConcurrency":
+                    caps[0] = max(0, int(value))
+                elif attr == "maxQueueDepth":
+                    caps[1] = max(0, int(value))
+            except (TypeError, ValueError):
+                continue
+        return {name: (c[0], c[1]) for name, c in out.items()}
+
     @property
     def serve_pipeline_enabled(self) -> bool:
         return self.get_bool(

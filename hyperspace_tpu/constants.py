@@ -420,3 +420,80 @@ RECOVERY_RETRY_BACKOFF_MS_DEFAULT = 10
 # Quarantine directory name (underscore-prefixed: invisible to data
 # scans, like HYPERSPACE_LOG_DIR).
 HYPERSPACE_QUARANTINE_DIR = "_hyperspace_quarantine"
+
+# -- replicated serve fleet (serve/fleet.py, serve/bus.py) -------------------
+# Master switch for fleet mode: N ServeFrontend processes over ONE index
+# lake. Turns on (a) DURABLE query pins — each pinned snapshot is also
+# published as a lease-expiring file under <index>/_hyperspace_pins/ so
+# an orphan GC or vacuum running in ANOTHER process never deletes files
+# under a live query; (b) the index-version fanout bus — lifecycle
+# actions publish change events under <system.path>/_hyperspace_fleet/
+# that peers poll to invalidate (or, for aggregate-plane state, install)
+# their ServeCache entries instead of serving stale pins; (c) cross-
+# process single-flight — identical plans submitted to several frontends
+# elect one executor through a fingerprint-keyed claim file and share
+# the answer through a bounded result spool. Off = the single-process
+# PR 8 behavior everywhere (in-memory pins, no bus, no spool).
+FLEET_ENABLED = "hyperspace.fleet.enabled"
+FLEET_ENABLED_DEFAULT = False
+
+# Durable pin lease: a fleet frontend's pin files are renewed every
+# leaseMs/3 by a heartbeat thread; a pin whose lease expired belongs to
+# a DEAD frontend (kill -9, OOM) and is reaped by the next GC/vacuum —
+# the recovery plane's writer-lease discriminator applied to readers.
+FLEET_PIN_LEASE_MS = "hyperspace.fleet.pin.leaseMs"
+FLEET_PIN_LEASE_MS_DEFAULT = 30_000
+
+# Fanout bus poll cadence: how often each subscribed frontend lists the
+# bus directory for events published by its peers.
+FLEET_BUS_POLL_MS = "hyperspace.fleet.bus.pollMs"
+FLEET_BUS_POLL_MS_DEFAULT = 100
+
+# Bus event retention: event files older than this are pruned by the
+# next publisher (every subscriber that was alive at publish time has
+# long since polled them; a frontend attaching later starts from the
+# current state anyway).
+FLEET_BUS_RETAIN_MS = "hyperspace.fleet.bus.retainMs"
+FLEET_BUS_RETAIN_MS_DEFAULT = 60_000
+
+# Cross-process single-flight: identical plans arriving at N frontends
+# elect ONE executor via an atomic claim file keyed by the plan + pinned
+# snapshot fingerprint; the losers wait up to waitMs for the winner's
+# spooled result before executing locally (correctness never depends on
+# the election — a timeout just forfeits the dedup win). claimMs bounds
+# how long a dead winner's claim blocks peers.
+FLEET_SINGLEFLIGHT_ENABLED = "hyperspace.fleet.singleflight.enabled"
+FLEET_SINGLEFLIGHT_ENABLED_DEFAULT = True
+FLEET_SINGLEFLIGHT_WAIT_MS = "hyperspace.fleet.singleflight.waitMs"
+FLEET_SINGLEFLIGHT_WAIT_MS_DEFAULT = 5_000
+FLEET_SINGLEFLIGHT_CLAIM_MS = "hyperspace.fleet.singleflight.claimMs"
+FLEET_SINGLEFLIGHT_CLAIM_MS_DEFAULT = 10_000
+
+# Result spool byte budget: the winner of a single-flight election
+# publishes its answer as an Arrow IPC file under
+# <system.path>/_hyperspace_fleet/spool/; writers prune the oldest
+# results past this budget (results are version-addressed — a refresh
+# re-keys every plan, so stale entries are unreachable, only unread).
+FLEET_SPOOL_MAX_BYTES = "hyperspace.fleet.spool.maxBytes"
+FLEET_SPOOL_MAX_BYTES_DEFAULT = 256 << 20  # 256 MiB
+
+# Per-tenant SLO classes (prefix family, like hyperspace.faults.):
+# hyperspace.fleet.class.<name>.maxConcurrency caps how many queries of
+# class <name> RUN at once on a frontend (0 = unlimited; excess admits
+# queue without occupying worker threads), and
+# hyperspace.fleet.class.<name>.maxQueueDepth sheds class-<name>
+# admissions past that backlog with a typed ServeOverloadedError —
+# layered UNDER the global hyperspace.serve.maxQueueDepth bound, so a
+# batch tier with a tight class budget sheds before the interactive
+# tier feels any pressure. Queries submitted without a class (or with
+# an unconfigured class name) see only the global bounds.
+FLEET_CLASS_KEY_PREFIX = "hyperspace.fleet.class."
+
+# Durable pin directory name (underscore-prefixed, next to the log —
+# invisible to data scans like the quarantine dir).
+HYPERSPACE_PINS_DIR = "_hyperspace_pins"
+
+# Fleet coordination directory under the lake root (hyperspace.system.
+# path): <root>/_hyperspace_fleet/bus/ event files +
+# <root>/_hyperspace_fleet/spool/ single-flight claims and results.
+HYPERSPACE_FLEET_DIR = "_hyperspace_fleet"

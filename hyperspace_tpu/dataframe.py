@@ -162,14 +162,17 @@ class DataFrame:
         return self.collect()
 
     def collect_approx(self, max_rel_error=None) -> pa.Table:
-        """APPROXIMATE answer for an ungrouped COUNT/SUM aggregate from
-        the index's stratified row sample, with 95% confidence intervals
-        (columns ``x`` / ``x_lo`` / ``x_hi`` per aggregate ``x``; see
-        docs/agg-serve.md). Explicit opt-in behind
+        """APPROXIMATE answer for an ungrouped — or single-key
+        GROUPED — COUNT/SUM aggregate from the index's stratified row
+        sample, with 95% confidence intervals (columns ``x`` / ``x_lo``
+        / ``x_hi`` per aggregate ``x``; grouped shapes lead with the
+        key column, one row per group the sample observed, key-sorted;
+        see docs/agg-serve.md). Explicit opt-in behind
         ``hyperspace.serve.approx.enabled`` — exact serving NEVER
         substitutes this, and an estimate blowing the error budget
         (``max_rel_error`` or ``hyperspace.serve.approx.maxRelativeError``)
-        raises a typed ApproximationError instead of returning it."""
+        in ANY group raises a typed ApproximationError instead of
+        returning it."""
         from hyperspace_tpu.execution.approx_exec import approx_aggregate
 
         return approx_aggregate(self._session, self._plan, max_rel_error)

@@ -47,29 +47,40 @@ last_approx_stats: Dict[str, Any] = {}
 
 
 def _match_plan(plan):
-    """(cond | None, scan) when the optimized plan is an ungrouped
-    Aggregate over [Project] [Filter] Scan, else None."""
-    if not isinstance(plan, Aggregate) or plan.group_by:
+    """(cond | None, scan, group key | None) when the optimized plan is
+    an ungrouped or SINGLE-KEY grouped Aggregate over [Project] [Filter]
+    Scan, else None."""
+    if not isinstance(plan, Aggregate) or len(plan.group_by) > 1:
         return None
+    key = plan.group_by[0] if plan.group_by else None
     node = plan.child
     while isinstance(node, Project):
         node = node.child
     if isinstance(node, Filter) and isinstance(node.child, Scan):
-        return node.condition, node.child
+        return node.condition, node.child, key
     if isinstance(node, Scan):
-        return None, node
+        return None, node, key
     return None
 
 
 def approx_aggregate(
     session, plan, max_rel_error: Optional[float] = None
 ) -> pa.Table:
-    """Estimate an ungrouped COUNT/SUM aggregate from the stratified
-    index sample. Returns one row with, per aggregate ``x``, columns
-    ``x`` (the estimate), ``x_lo`` and ``x_hi`` (the 95% CI) — all
-    float64, so an approximate answer can never be mistaken for the
-    exact integer result. Raises :class:`ApproximationError` whenever an
-    honest bounded estimate is impossible."""
+    """Estimate an ungrouped — or single-key GROUPED — COUNT/SUM
+    aggregate from the stratified index sample. Ungrouped: one row
+    with, per aggregate ``x``, columns ``x`` (the estimate), ``x_lo``
+    and ``x_hi`` (the 95% CI). Grouped: one row per group OBSERVED in
+    the passing sample (key-sorted, nulls last), the key column first,
+    then the same ``x``/``x_lo``/``x_hi`` triple per aggregate — each
+    group gets its own interval from the same stratified estimator
+    (``y`` restricted to the group's rows; zeros elsewhere count toward
+    the variance, exactly the theory asks). Estimates are float64, so
+    an approximate answer can never be mistaken for the exact integer
+    result; groups too rare for the sample to see are absent (the
+    per-group budget check bounds what CAN be returned — a group whose
+    interval blows the budget raises instead). Raises
+    :class:`ApproximationError` whenever an honest bounded estimate is
+    impossible."""
     global last_approx_stats
     if session is None or not session.conf.serve_approx_enabled:
         raise ApproximationError(
@@ -86,9 +97,10 @@ def approx_aggregate(
     m = _match_plan(optimized)
     if m is None:
         raise ApproximationError(
-            "only ungrouped Filter→Aggregate plans are approximable"
+            "only ungrouped or single-key grouped Filter→Aggregate "
+            "plans are approximable"
         )
-    cond, scan = m
+    cond, scan, group_key = m
     rel = scan.relation
     from hyperspace_tpu.execution import executor as X
 
@@ -143,6 +155,84 @@ def approx_aggregate(
         )
     H = len(N)
     fpc = np.clip(1.0 - n / N, 0.0, 1.0)
+
+    # -- group factorization over the PASSING sample rows --------------------
+    # One virtual group for the ungrouped shape keeps the estimator a
+    # single [H, G] computation either way: y restricted to a group is
+    # zero on every other row, and those zeros belong in the stratum
+    # mean/variance — that is what makes the per-group interval honest.
+    if group_key is None:
+        G = 1
+        codes = np.zeros(ns, dtype=np.int64)
+        grouped_rows = passing
+        key_values = None
+    else:
+        if group_key not in batch.column_names:
+            raise ApproximationError(
+                f"group key {group_key!r} is not in the index sample — "
+                "only indexed columns are estimable"
+            )
+        kcol = batch.column(group_key)
+        rep = kcol.key_rep()
+        nm = kcol.null_mask
+        valid = np.ones(ns, dtype=bool) if nm is None else ~nm
+        # null keys form their own group, like the exact engine's
+        # factorize; an out-of-range rep stands in for them
+        grouped_rows = passing
+        pass_valid = passing & valid
+        uniq = np.unique(rep[pass_valid])
+        has_null_group = bool(np.any(passing & ~valid))
+        G = len(uniq) + int(has_null_group)
+        codes = np.searchsorted(uniq, rep)
+        codes = np.clip(codes, 0, max(len(uniq) - 1, 0))
+        # rows whose rep is not actually in uniq (non-passing values)
+        # only matter where grouped_rows is True, and there membership
+        # is exact; null rows get the trailing group id
+        if has_null_group:
+            codes = np.where(valid, codes, len(uniq))
+        # group key values for the output: first passing occurrence
+        order = np.argsort(codes[pass_valid], kind="stable")
+        first_idx = np.nonzero(pass_valid)[0][order]
+        _codes_sorted = codes[pass_valid][order]
+        firsts = first_idx[
+            np.searchsorted(_codes_sorted, np.arange(len(uniq)))
+        ]
+        arrow_key = sample["table"].column(group_key)
+        key_values = arrow_key.take(pa.array(firsts, type=pa.int64()))
+        if has_null_group:
+            key_values = pa.concat_arrays(
+                [
+                    key_values.combine_chunks()
+                    if isinstance(key_values, pa.ChunkedArray)
+                    else key_values,
+                    pa.nulls(1, type=arrow_key.type),
+                ]
+            )
+
+    def _estimate(y: np.ndarray):
+        """[G] estimates + half-widths from the stratified estimator
+        applied per group (y already zeroed outside its rows)."""
+        member = grouped_rows
+        idx = stratum * G + codes
+        sums = np.bincount(
+            idx[member], weights=y[member], minlength=H * G
+        ).reshape(H, G)
+        sq = np.bincount(
+            idx[member], weights=(y * y)[member], minlength=H * G
+        ).reshape(H, G)
+        n_col = n[:, None]
+        mean = sums / n_col
+        with np.errstate(invalid="ignore", divide="ignore"):
+            var_h = np.where(
+                n_col > 1, (sq - n_col * mean * mean) / (n_col - 1), 0.0
+            )
+        var_h = np.maximum(var_h, 0.0)
+        est = np.sum(N[:, None] * mean, axis=0)
+        var = np.sum(
+            N[:, None] * N[:, None] * var_h / n_col * fpc[:, None], axis=0
+        )
+        return est, _Z95 * np.sqrt(np.maximum(var, 0.0))
+
     out: Dict[str, Any] = {}
     rel_errs = []
     for spec in plan.aggs:
@@ -152,10 +242,10 @@ def approx_aggregate(
             else:
                 col = batch.column(spec.column)
                 nm = col.null_mask
-                valid = (
+                valid_c = (
                     np.ones(ns, dtype=bool) if nm is None else ~nm
                 )
-                y = (passing & valid).astype(np.float64)
+                y = (passing & valid_c).astype(np.float64)
         else:  # sum
             col = batch.column(spec.column)
             if col.kind != "numeric":
@@ -167,38 +257,45 @@ def approx_aggregate(
             if nm is not None:
                 v = np.where(nm, 0.0, v)
             y = np.where(passing, v, 0.0)
-        # per-stratum mean and (ddof=1) variance of y
-        sums = np.bincount(stratum, weights=y, minlength=H)
-        sq = np.bincount(stratum, weights=y * y, minlength=H)
-        mean = sums / n
-        with np.errstate(invalid="ignore", divide="ignore"):
-            var_h = np.where(
-                n > 1, (sq - n * mean * mean) / (n - 1), 0.0
-            )
-        var_h = np.maximum(var_h, 0.0)
-        est = float(np.sum(N * mean))
-        var = float(np.sum(N * N * var_h / n * fpc))
-        hw = _Z95 * np.sqrt(max(var, 0.0))
+        est, hw = _estimate(y)
         out[spec.name] = est
         out[spec.name + "_lo"] = est - hw
         out[spec.name + "_hi"] = est + hw
-        rel_err = hw / abs(est) if est != 0.0 else (0.0 if hw == 0.0 else np.inf)
-        rel_errs.append((spec.name, rel_err))
-        if rel_err > budget:
+        with np.errstate(invalid="ignore", divide="ignore"):
+            rel = np.where(
+                est != 0.0,
+                hw / np.abs(est),
+                np.where(hw == 0.0, 0.0, np.inf),
+            )
+        worst = float(np.max(rel)) if len(rel) else 0.0
+        rel_errs.append((spec.name, worst))
+        if worst > budget:
             raise ApproximationError(
                 f"estimate for {spec.name!r} has relative 95%-CI "
-                f"half-width {rel_err:.4f} > budget {budget:.4f} — "
-                "run exact, or widen the budget / enlarge "
+                f"half-width {worst:.4f} > budget {budget:.4f}"
+                + (
+                    " in at least one group"
+                    if group_key is not None
+                    else ""
+                )
+                + " — run exact, or widen the budget / enlarge "
                 "hyperspace.index.agg.sampleRowsPerGroup"
             )
     last_approx_stats = {
         "mode": "agg_approx",
         "strata": H,
+        "groups": G if group_key is not None else 0,
         "sample_rows": int(ns),
         "population_rows": int(sample["N"].sum()),
         "rel_half_widths": {k: float(v) for k, v in rel_errs},
         "wall_s": time.perf_counter() - t0,
     }
-    return pa.table(
-        {k: pa.array([v], type=pa.float64()) for k, v in out.items()}
-    )
+    cols: Dict[str, Any] = {}
+    if key_values is not None:
+        cols[group_key] = key_values
+    for k, v in out.items():
+        cols[k] = pa.array(np.asarray(v, dtype=np.float64), type=pa.float64())
+    table = pa.table(cols)
+    if key_values is not None:
+        table = table.sort_by([(group_key, "ascending")])
+    return table

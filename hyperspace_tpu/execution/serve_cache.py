@@ -199,6 +199,33 @@ class ServeCache:
                 self._bytes -= nbytes
             return len(victims)
 
+    def evict_paths_under(self, root: str) -> int:
+        """Drop every entry whose fingerprint names a file under
+        ``root`` (an index directory). The fleet fanout's invalidation
+        primitive (``serve/bus.py``): a refresh/optimize/vacuum in a
+        PEER process re-keys or kills this index's entries — eviction
+        frees the dead versions' bytes proactively instead of letting
+        them age out of the LRU while fresher state fights for budget.
+        Keys are tuples nesting fingerprint tuples of (path, size,
+        mtime_ns) triples; the walk finds every string in the key, so
+        every current and future key shape is covered. Victim list built
+        and drained under the one cache lock, like ``evict_kind``."""
+        prefix = root.replace("\\", "/").rstrip("/") + "/"
+
+        def _mentions(obj) -> bool:
+            if isinstance(obj, str):
+                return obj.replace("\\", "/").startswith(prefix)
+            if isinstance(obj, tuple):
+                return any(_mentions(x) for x in obj)
+            return False
+
+        with self._lock:
+            victims = [k for k in self._entries if _mentions(k)]
+            for k in victims:
+                _, nbytes = self._entries.pop(k)
+                self._bytes -= nbytes
+            return len(victims)
+
     @property
     def resident_bytes(self) -> int:
         return self._bytes

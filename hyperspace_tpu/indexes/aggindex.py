@@ -760,6 +760,123 @@ def invalidate_local_cache() -> None:
         _local_cache.clear()
 
 
+def invalidate_paths_under(root: str) -> int:
+    """Drop only the LRU entries whose fingerprint names a file under
+    ``root`` — the fleet fanout's scoped invalidation (``serve/bus.py``;
+    same contract as ``zonemaps.invalidate_paths_under``): reclaim the
+    changed index's dead-version memory without costing other indexes
+    their warm assembled state."""
+    prefix = root.replace("\\", "/").rstrip("/") + "/"
+
+    def _mentions(obj) -> bool:
+        if isinstance(obj, str):
+            return obj.replace("\\", "/").startswith(prefix)
+        if isinstance(obj, tuple):
+            return any(_mentions(x) for x in obj)
+        return False
+
+    with _local_lock:
+        victims = [k for k in _local_cache if _mentions(k)]
+        for k in victims:
+            del _local_cache[k]
+        return len(victims)
+
+
+# ---------------------------------------------------------------------------
+# Fleet fanout (docs/fleet-serve.md): metadata answers are tiny and
+# version-addressed, so a refresh/optimize PUSHES the new version's
+# aggregate state to peer frontends instead of invalidating it — the
+# peers' first point aggregate over the new snapshot folds straight from
+# RAM without even the sidecar read.
+# ---------------------------------------------------------------------------
+
+
+def fanout_payload(files) -> Optional[dict]:
+    """JSON-safe push payload for one committed file set: the raw
+    per-file sidecar entries plus the file fingerprint the receivers key
+    by. None unless EVERY file has a stat-fresh sidecar entry — a
+    partial push would make the receiver's assembly lie about coverage,
+    and the lazy re-read path covers the gap anyway."""
+    from hyperspace_tpu.execution.serve_cache import file_fingerprint
+
+    files = tuple(files)
+    if not files:
+        return None
+    fp = file_fingerprint(files)
+    if fp is None:
+        return None
+    side_by_dir: Dict[str, Optional[dict]] = {}
+    entries: Dict[str, dict] = {}
+    for path in files:
+        d = os.path.dirname(path)
+        if d not in side_by_dir:
+            side_by_dir[d] = _sidecar_for_dir(d)
+        side = side_by_dir[d]
+        if side is None:
+            return None
+        entry = side.get("files", {}).get(os.path.basename(path))
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None
+        if (
+            entry is None
+            or entry.get("size") != st.st_size
+            or entry.get("mtime_ns") != st.st_mtime_ns
+        ):
+            return None
+        entries[path] = entry
+    return {
+        "files": list(files),
+        "fp": [[p, s, m] for p, s, m in fp],
+        "entries": entries,
+    }
+
+
+def install_fanout_payload(payload: dict, cache=None) -> bool:
+    """Install a pushed payload into this process's caches under
+    ``("aggstate", fp)``. Validates the fingerprint against the CURRENT
+    on-disk stats first — a stale push (the files changed again before
+    this frontend polled) would be cached under an unreachable key, so
+    it is dropped instead. Returns whether the install happened."""
+    from hyperspace_tpu.execution.serve_cache import file_fingerprint
+
+    try:
+        files = tuple(str(f) for f in payload["files"])
+        fp = tuple((str(p), int(s), int(m)) for p, s, m in payload["fp"])
+        raw_entries = payload["entries"]
+    except (KeyError, TypeError, ValueError):
+        return False
+    if not files or file_fingerprint(files) != fp:
+        return False
+    per_file: list = []
+    nbytes = 256
+    try:
+        for path in files:
+            decoded, nb = _decode_entry(raw_entries[path])
+            per_file.append(decoded)
+            nbytes += nb
+    except (KeyError, TypeError, ValueError):
+        return False
+    data = AggData(
+        files=files,
+        per_file=per_file,
+        sidecar_files=len(files),
+        backfill_files=0,
+        nbytes=nbytes,
+        backfill_keys=None,
+        per_file_sidecar=(True,) * len(files),
+    )
+    key = ("aggstate", fp)
+    if cache is not None:
+        cache.put(key, data, data.nbytes)
+    with _local_lock:
+        _local_cache[key] = data
+        while len(_local_cache) > _LOCAL_CACHE_ENTRIES:
+            _local_cache.popitem(last=False)
+    return True
+
+
 # ---------------------------------------------------------------------------
 # Classification: FULL / EMPTY / PARTIAL per selected row group
 # ---------------------------------------------------------------------------

@@ -816,6 +816,28 @@ def invalidate_local_cache() -> None:
         _local_cache.clear()
 
 
+def invalidate_paths_under(root: str) -> int:
+    """Drop only the LRU entries whose fingerprint names a file under
+    ``root`` — the fleet fanout's scoped invalidation
+    (``serve/bus.py``): a refresh of index A must not cost index B its
+    warm assembled state. Entries are fingerprint-keyed so this is pure
+    memory reclamation, never a staleness fix."""
+    prefix = root.replace("\\", "/").rstrip("/") + "/"
+
+    def _mentions(obj) -> bool:
+        if isinstance(obj, str):
+            return obj.replace("\\", "/").startswith(prefix)
+        if isinstance(obj, tuple):
+            return any(_mentions(x) for x in obj)
+        return False
+
+    with _local_lock:
+        victims = [k for k in _local_cache if _mentions(k)]
+        for k in victims:
+            del _local_cache[k]
+        return len(victims)
+
+
 # ---------------------------------------------------------------------------
 # The pruning pass
 # ---------------------------------------------------------------------------

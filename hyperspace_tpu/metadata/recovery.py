@@ -44,6 +44,7 @@ same bytes.
 
 from __future__ import annotations
 
+import json
 import os
 import shutil
 import threading
@@ -52,8 +53,11 @@ import uuid
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from hyperspace_tpu.constants import (
+    FLEET_PIN_LEASE_MS_DEFAULT,
     HYPERSPACE_LOG_DIR,
+    HYPERSPACE_PINS_DIR,
     HYPERSPACE_QUARANTINE_DIR,
+    INDEX_VERSION_DIR_PREFIX,
     RECOVERY_LEASE_MS_DEFAULT,
     RECOVERY_ORPHAN_GRACE_MS_DEFAULT,
     States,
@@ -313,26 +317,201 @@ _pins_lock = threading.Lock()
 _active_pins: Dict[int, frozenset] = {}
 _pin_seq = 0
 
+#: this process's durable-pin identity (immutable; pin files are named
+#: ``<owner>.<token>.json`` so two frontends in two processes can never
+#: collide, and a restarted process never renews its predecessor's pins)
+_pin_owner = uuid.uuid4().hex[:16]
 
-def register_pins(entries: Optional[Iterable[IndexLogEntry]]) -> int:
+# token -> {"lease_ms": int, "paths": {pin file path: [files]}} for the
+# heartbeat's renewal sweep (SHARED_STATE: guarded by _pins_lock)
+_durable_pins: Dict[int, Dict[str, object]] = {}
+_pin_heartbeat = None  # the renewal thread, started on first durable pin
+
+
+def _index_root_of(path: str) -> Optional[str]:
+    """The index root a data file lives under — the parent of its
+    ``v__=N`` version-dir component — or None for a path outside any
+    version dir (not durably pinnable; the in-memory pin still holds)."""
+    norm = path.replace("\\", "/")
+    parts = norm.split("/")
+    for i in range(len(parts) - 1, -1, -1):
+        if parts[i].startswith(INDEX_VERSION_DIR_PREFIX + "="):
+            return "/".join(parts[:i])
+    return None
+
+
+def _pin_file_payload(token: int, files: List[str], lease_ms: int) -> str:
+    return json.dumps(
+        {
+            "owner": _pin_owner,
+            "pid": os.getpid(),
+            "token": token,
+            "leaseMs": int(lease_ms),
+            "expiresAtMs": now_ms() + int(lease_ms),
+            "files": sorted(files),
+        }
+    )
+
+
+def _write_pin_files(
+    token: int, by_root: Dict[str, List[str]], lease_ms: int
+) -> Dict[str, List[str]]:
+    """Publish one pin file per index root (fsync-before-replace);
+    returns {pin file path: files}. Best-effort per root: an unwritable
+    pins dir costs the durable protection for that index only — the
+    in-memory pin still guards same-process GC, and failing the QUERY
+    over a bookkeeping write would invert the priorities."""
+    out: Dict[str, List[str]] = {}
+    for root, files in by_root.items():
+        pin_path = os.path.join(
+            root, HYPERSPACE_PINS_DIR, f"{_pin_owner}.{token}.json"
+        )
+        try:
+            file_utils.atomic_overwrite(
+                pin_path, _pin_file_payload(token, files, lease_ms)
+            )
+        except OSError:
+            continue
+        out[pin_path] = files
+    return out
+
+
+class _PinHeartbeat:
+    """Renews every live durable pin file each ``min(lease)/3`` until the
+    process exits — the reader-side twin of :class:`LeaseHeartbeat`. A
+    SIGKILL never stops it; the leases expire and the next GC/vacuum in
+    any process reaps the pins, which is the signal."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="hs-pin-heartbeat", daemon=True
+        )
+        self._thread.start()
+
+    def wake(self) -> None:
+        """Cut the current wait short — a newly registered pin may carry
+        a much shorter lease than the interval the thread is sleeping
+        on."""
+        self._wake.set()
+
+    def _run(self) -> None:
+        while True:
+            # clear BEFORE snapshotting: a pin registered after the
+            # snapshot sets the event and cuts the wait short; one
+            # registered before it is in the snapshot — either way no
+            # short-lease pin waits out a stale interval
+            self._wake.clear()
+            with _pins_lock:
+                snapshot = [
+                    (t, int(info["lease_ms"]), dict(info["paths"]))
+                    for t, info in _durable_pins.items()
+                ]
+            interval = (
+                min((lease for _t, lease, _p in snapshot), default=1000)
+                / 3000.0
+            )
+            self._wake.wait(max(interval, 0.005))
+            if self._stop.is_set():
+                return
+            for token, lease_ms, paths in snapshot:
+                with _pins_lock:
+                    live = token in _durable_pins
+                if not live:
+                    continue
+                for pin_path, files in paths.items():
+                    try:
+                        file_utils.atomic_overwrite(
+                            pin_path,
+                            _pin_file_payload(token, files, lease_ms),
+                        )
+                    except OSError:
+                        # best-effort, like the writer lease: a failed
+                        # renewal only ages the pin; the next tick
+                        # retries, and expiry under a truly dead store
+                        # is the designed outcome
+                        continue
+                    # write-then-verify: release_pins may have deleted
+                    # the file between the liveness check above and our
+                    # rewrite — a resurrected pin would block GC/vacuum
+                    # for a full lease, so re-check and undo
+                    with _pins_lock:
+                        live = token in _durable_pins
+                    if not live:
+                        file_utils.delete(pin_path)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+
+
+def register_pins(
+    entries: Optional[Iterable[IndexLogEntry]],
+    durable: bool = False,
+    lease_ms: int = FLEET_PIN_LEASE_MS_DEFAULT,
+    heartbeat: bool = True,
+) -> int:
     """Record the index files a serve snapshot depends on; returns a
     token for :func:`release_pins`. GC never quarantines a pinned file,
     so a query that pinned its snapshot before a version went
-    unreferenced still finds every byte."""
+    unreferenced still finds every byte.
+
+    With ``durable=True`` (fleet mode, docs/fleet-serve.md) the pin is
+    ALSO published as a lease-expiring file per index root —
+    ``<index>/_hyperspace_pins/<proc>.<seq>.json``, fsync-before-replace
+    — so an orphan GC or vacuum running in ANOTHER process sees it too.
+    A heartbeat renews the lease every ``lease_ms/3``; a frontend that
+    dies (kill -9) stops renewing and the pin is reaped at expiry
+    (``heartbeat=False`` exists for the tests that simulate exactly
+    that death)."""
     files: Set[str] = set()
     for e in entries or ():
         files.update(p.replace("\\", "/") for p in e.content.files)
-    global _pin_seq
+    global _pin_seq, _pin_heartbeat
     with _pins_lock:
         _pin_seq += 1
         token = _pin_seq
         _active_pins[token] = frozenset(files)
+    if not durable or not files:
+        return token
+    by_root: Dict[str, List[str]] = {}
+    for f in files:
+        root = _index_root_of(f)
+        if root is not None:
+            by_root.setdefault(root, []).append(f)
+    # file I/O stays OUTSIDE the pins lock (HS5xx: no I/O under a lock
+    # serve threads contend on)
+    written = _write_pin_files(token, by_root, lease_ms)
+    if written:
+        with _pins_lock:
+            if token in _active_pins:
+                _durable_pins[token] = {
+                    "lease_ms": int(lease_ms),
+                    "paths": written,
+                }
+                if heartbeat:
+                    if _pin_heartbeat is None:
+                        _pin_heartbeat = _PinHeartbeat()
+                    else:
+                        _pin_heartbeat.wake()
+                doomed = {}
+            else:
+                # release_pins raced us between the write and this
+                # record: the pin files must not outlive the token
+                doomed = written
+        for pin_path in doomed:
+            file_utils.delete(pin_path)
     return token
 
 
 def release_pins(token: int) -> None:
     with _pins_lock:
         _active_pins.pop(token, None)
+        durable = _durable_pins.pop(token, None)
+    if durable:
+        for pin_path in durable["paths"]:
+            file_utils.delete(pin_path)
 
 
 def pinned_files() -> Set[str]:
@@ -343,6 +522,64 @@ def pinned_files() -> Set[str]:
     for s in snapshots:
         out |= s
     return out
+
+
+def _scan_durable_pins(
+    index_path: str, now: Optional[int] = None, reap: bool = True
+) -> Tuple[Set[str], int]:
+    """(files protected by UNEXPIRED pin files under ``index_path``,
+    expired/torn pin files reaped). An expired pin belongs to a dead
+    frontend — its query either finished or died with it, so the file
+    set converges back to the referenced-or-quarantined partition; a
+    torn pin file can protect nothing and is reaped the same way."""
+    pins_dir = os.path.join(index_path, HYPERSPACE_PINS_DIR)
+    if not os.path.isdir(pins_dir):
+        return set(), 0
+    now = now_ms() if now is None else now
+    out: Set[str] = set()
+    reaped = 0
+    for name in sorted(os.listdir(pins_dir)):
+        if not name.endswith(".json"):
+            continue  # publish temps (.tmp_log_*) are not pins
+        p = os.path.join(pins_dir, name)
+        try:
+            with open(p, "r", encoding="utf-8") as fh:
+                doc = json.load(fh)
+            expires = int(doc["expiresAtMs"])
+        except (OSError, ValueError, KeyError, TypeError):
+            if reap:
+                file_utils.delete(p)
+                reaped += 1
+            continue
+        if expires <= now:
+            if reap:
+                file_utils.delete(p)
+                reaped += 1
+            continue
+        out.update(str(f).replace("\\", "/") for f in doc.get("files", ()))
+    if reap:
+        try:
+            if not os.listdir(pins_dir):
+                os.rmdir(pins_dir)
+        except OSError:
+            pass
+    return out, reaped
+
+
+def durable_pinned_files(
+    index_path: str, now: Optional[int] = None
+) -> Set[str]:
+    """Files protected by live (lease-unexpired) cross-process pin files
+    under ``index_path``; expired pins are reaped along the way."""
+    files, _reaped = _scan_durable_pins(index_path, now)
+    return files
+
+
+def all_pinned_files(index_path: str, now: Optional[int] = None) -> Set[str]:
+    """Everything a GC or vacuum of ``index_path`` must not delete:
+    this process's in-memory pins UNION every process's live durable
+    pin files (fleet mode)."""
+    return pinned_files() | durable_pinned_files(index_path, now)
 
 
 # ---------------------------------------------------------------------------
@@ -379,7 +616,11 @@ def find_orphans(index_path: str) -> List[str]:
     referenced = _referenced_files(log_manager)
     orphans: List[str] = []
     for name in sorted(os.listdir(index_path)):
-        if name in (HYPERSPACE_LOG_DIR, HYPERSPACE_QUARANTINE_DIR):
+        if name in (
+            HYPERSPACE_LOG_DIR,
+            HYPERSPACE_QUARANTINE_DIR,
+            HYPERSPACE_PINS_DIR,
+        ):
             continue
         root = os.path.join(index_path, name)
         if not os.path.isdir(root):
@@ -425,6 +666,7 @@ def gc_orphans(
         "quarantined_dirs": 0,
         "kept_pinned": 0,
         "purged_stamps": 0,
+        "reaped_pins": 0,
         "skipped_live_writer": False,
     }
     latest_id = log_manager.get_latest_id()
@@ -443,7 +685,9 @@ def gc_orphans(
         _purge_quarantine(index_path, grace_ms, now, report)
         return report
     referenced = _referenced_files(log_manager)
-    pinned = pinned_files()
+    durable, reaped = _scan_durable_pins(index_path, now)
+    report["reaped_pins"] = reaped
+    pinned = pinned_files() | durable
     quarantine_root = os.path.join(index_path, HYPERSPACE_QUARANTINE_DIR)
     stamp_dir = os.path.join(quarantine_root, str(now))
 
@@ -454,7 +698,11 @@ def gc_orphans(
         shutil.move(src, dst)
 
     for name in sorted(os.listdir(index_path)):
-        if name in (HYPERSPACE_LOG_DIR, HYPERSPACE_QUARANTINE_DIR):
+        if name in (
+            HYPERSPACE_LOG_DIR,
+            HYPERSPACE_QUARANTINE_DIR,
+            HYPERSPACE_PINS_DIR,
+        ):
             continue
         root = os.path.join(index_path, name)
         if not os.path.isdir(root):

@@ -1,11 +1,29 @@
-"""Concurrent serve frontend (docs/serve-server.md).
+"""Concurrent serve frontend (docs/serve-server.md) and the replicated
+fleet member built on it (docs/fleet-serve.md).
 
 The long-lived, many-queries-one-process plane over the single-query
-engine: admission control (single-flight dedup + load shedding),
-snapshot-consistent index pinning, and retry/degrade at the operation
-boundary. See :mod:`hyperspace_tpu.serve.frontend`.
+engine: admission control (single-flight dedup + load shedding, per-
+tenant SLO classes), snapshot-consistent index pinning, and
+retry/degrade at the operation boundary — see
+:mod:`hyperspace_tpu.serve.frontend`. In fleet mode
+(``hyperspace.fleet.enabled``) the frontend becomes a
+:class:`~hyperspace_tpu.serve.fleet.FleetFrontend`: durable cross-
+process pins, index-version fanout over the bus
+(:mod:`hyperspace_tpu.serve.bus`), and cross-process single-flight
+through the claim/spool plane.
 """
 
 from hyperspace_tpu.serve.frontend import ServeFrontend, plan_fingerprint
 
-__all__ = ["ServeFrontend", "plan_fingerprint"]
+
+def __getattr__(name):
+    # FleetFrontend lazily: most sessions never enter fleet mode, and
+    # the fleet module pulls in the bus/spool machinery
+    if name == "FleetFrontend":
+        from hyperspace_tpu.serve.fleet import FleetFrontend
+
+        return FleetFrontend
+    raise AttributeError(name)
+
+
+__all__ = ["ServeFrontend", "FleetFrontend", "plan_fingerprint"]

@@ -1,0 +1,312 @@
+"""FleetFrontend — one member of a replicated serve fleet.
+
+``ServeFrontend`` (PR 8) scales one PROCESS to 64 clients; "millions of
+users" means N processes on M hosts sharing one index lake. This module
+is the per-process member of that fleet (docs/fleet-serve.md). The
+design rule, inherited from the crash-safe lifecycle plane and argued by
+Exoshuffle (PAPERS.md): the fleet coordinates through small, durable,
+lease-stamped files next to the data it protects — never through shared
+memory, never through a coordinator service. Three planes on top of the
+inherited frontend:
+
+* **Durable pins.** Every admitted query's pinned snapshot is ALSO
+  published as a lease-expiring file under
+  ``<index>/_hyperspace_pins/`` (``metadata/recovery.register_pins
+  (durable=True)``), heartbeat-renewed — so an orphan GC or a vacuum
+  running in ANOTHER process never deletes files under a live query,
+  and a frontend that dies (kill -9) stops renewing and its pins are
+  reaped at lease expiry instead of leaking forever.
+
+* **Version fanout.** The frontend subscribes to the fleet bus
+  (``serve/bus.py``): a refresh/optimize/vacuum committed by any peer
+  evicts this process's ``ServeCache`` entries for the changed index
+  (instead of letting dead versions age out of the LRU) and INSTALLS
+  pushed ``("aggstate", fp)`` payloads — metadata answers are tiny and
+  version-addressed, so the first point aggregate over the new snapshot
+  folds straight from RAM.
+
+* **Cross-process single-flight.** The in-process dedup saved 256 of
+  512 identical queries at one process; at eight processes it would
+  save none. Identical plans (same fingerprint, same pinned snapshot)
+  now elect ONE executor fleet-wide through an atomic claim file, and
+  the winner publishes its answer as an Arrow IPC file in a bounded
+  result spool the losers read. Correctness never depends on the
+  election: a lost claim plus a missing result just executes locally
+  after ``hyperspace.fleet.singleflight.waitMs`` — the timeout forfeits
+  the dedup win, never the answer — and results are keyed by the
+  immutable snapshot fingerprint, so a stale spool entry is
+  unreachable, not wrong.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import time
+import uuid
+from typing import Optional, Tuple
+
+import pyarrow as pa
+
+from hyperspace_tpu.metadata import recovery
+from hyperspace_tpu.serve import bus as fleet_bus
+from hyperspace_tpu.serve.frontend import ServeFrontend, plan_fingerprint
+from hyperspace_tpu.utils import files as file_utils
+
+_log = logging.getLogger("hyperspace_tpu.fleet")
+
+#: claim losers re-check the spool at this cadence while waiting
+_SPOOL_POLL_S = 0.01
+
+
+def spool_dir(conf) -> str:
+    return os.path.join(fleet_bus.fleet_root(conf), "spool")
+
+
+class FleetFrontend(ServeFrontend):
+    """A :class:`ServeFrontend` wired into the fleet planes. Drop-in:
+    ``session.serve_frontend`` returns one automatically when
+    ``hyperspace.fleet.enabled`` is true."""
+
+    def __init__(self, session):
+        super().__init__(session)
+        conf = session.conf
+        self._spool_dir = spool_dir(conf)
+        self._pin_lease_ms = conf.fleet_pin_lease_ms
+        self._sf_enabled = conf.fleet_singleflight_enabled
+        self._sf_wait_s = conf.fleet_singleflight_wait_ms / 1000.0
+        self._sf_claim_ms = conf.fleet_singleflight_claim_ms
+        self._spool_max_bytes = conf.fleet_spool_max_bytes
+        # fleet counters (mutated under the frontend lock, like the
+        # base counters; all I/O happens outside it)
+        self._spool_hits = 0
+        self._claims_won = 0
+        self._claim_waits = 0
+        self._sf_local = 0
+        self._bus_events = 0
+        self._bus_evicted = 0
+        self._bus_installed = 0
+        self._bus = fleet_bus.FleetBus(
+            fleet_bus.bus_dir(conf),
+            poll_ms=conf.fleet_bus_poll_ms,
+            retain_ms=conf.fleet_bus_retain_ms,
+        )
+        self._bus.start(self._on_bus_event)
+
+    # -- durable pins --------------------------------------------------------
+    def _register_pins(self, pin: Optional[Tuple]) -> int:
+        return recovery.register_pins(
+            pin, durable=True, lease_ms=self._pin_lease_ms
+        )
+
+    # -- version fanout ------------------------------------------------------
+    def _on_bus_event(self, event: dict) -> None:
+        if event.get("type") != "index_changed":
+            return
+        with self._lock:
+            self._bus_events += 1
+        root = event.get("root")
+        cache = self._session.serve_cache
+        evicted = 0
+        from hyperspace_tpu.indexes import aggindex, zonemaps
+
+        if root:
+            if cache is not None:
+                evicted = cache.evict_paths_under(str(root))
+            # the module LRUs hold assembled per-version state too —
+            # scoped the same way (fingerprint-keyed, so this is pure
+            # memory reclamation: a refresh of index A must not cost
+            # index B its warm state on every peer)
+            zonemaps.invalidate_paths_under(str(root))
+            aggindex.invalidate_paths_under(str(root))
+        installed = False
+        payload = event.get("aggstate")
+        if payload:
+            # the push plane (ROADMAP 2c): install the new version's
+            # aggregate state instead of waiting for a lazy re-read
+            installed = aggindex.install_fanout_payload(payload, cache)
+        with self._lock:
+            self._bus_evicted += evicted
+            self._bus_installed += bool(installed)
+
+    # -- cross-process single-flight -----------------------------------------
+    def _plan_digest(self, plan, pin) -> Optional[str]:
+        """Fleet-wide identity of (plan, pinned snapshot): the in-process
+        fingerprint minus the process-local conf version, hashed. Every
+        component is strings/ints/tuples, so ``repr`` is deterministic
+        across processes."""
+        try:
+            key = (
+                plan_fingerprint(plan),
+                tuple((e.name, e.id) for e in pin),
+            )
+            return hashlib.sha256(repr(key).encode("utf-8")).hexdigest()[:40]
+        except Exception:  # hslint: disable=HS402
+            # any unfingerprintable plan simply skips the dedup plane
+            return None
+
+    def _read_spool(self, path: str):
+        try:
+            with open(path, "rb") as fh:
+                data = fh.read()
+            return pa.ipc.open_stream(pa.py_buffer(data)).read_all()
+        except (OSError, pa.ArrowInvalid):
+            return None
+
+    def _write_spool(self, path: str, table) -> None:
+        """Publish a result (fsync-before-replace; best-effort — an
+        unwritable spool costs peers the dedup win, not the answer)."""
+        try:
+            sink = pa.BufferOutputStream()
+            with pa.ipc.new_stream(sink, table.schema) as writer:
+                writer.write_table(table)
+            file_utils.atomic_overwrite_bytes(
+                path, sink.getvalue().to_pybytes()
+            )
+        except (OSError, pa.ArrowInvalid) as exc:
+            _log.warning("fleet spool write failed: %s", exc)
+            return
+        self._prune_spool()
+
+    def _prune_spool(self) -> None:
+        """Keep the spool inside its byte budget (oldest results first)
+        and sweep expired claims + crash-leaked publish temps."""
+        try:
+            names = os.listdir(self._spool_dir)
+        except OSError:
+            return
+        now = time.time()
+        entries = []
+        for name in names:
+            p = os.path.join(self._spool_dir, name)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            if name.endswith(".arrow"):
+                entries.append((st.st_mtime, st.st_size, p))
+            elif name.startswith(".tmp_spool_"):
+                # a kill -9 mid-publish leaks the temp; claim lease is a
+                # generous upper bound on how long a legitimate publish
+                # can still be in flight
+                if (now - st.st_mtime) * 1000 > self._sf_claim_ms:
+                    file_utils.delete(p)
+            elif name.endswith(".claim"):
+                if (now - st.st_mtime) * 1000 > self._sf_claim_ms:
+                    file_utils.delete(p)
+        total = sum(size for _m, size, _p in entries)
+        if self._spool_max_bytes <= 0:
+            return
+        for _mtime, size, p in sorted(entries):
+            if total <= self._spool_max_bytes:
+                break
+            file_utils.delete(p)
+            total -= size
+
+    def _try_claim(self, claim_path: str) -> str:
+        """One attempt at the executor election: ``"won"`` | ``"held"``
+        (a live peer owns it) | ``"error"`` (spool unusable — execute
+        locally, the plane is an optimization)."""
+        nonce = uuid.uuid4().hex
+        payload = json.dumps(
+            {
+                "owner": fleet_bus._process_owner,
+                "nonce": nonce,
+                "pid": os.getpid(),
+                "expiresAtMs": int(time.time() * 1000) + self._sf_claim_ms,
+            }
+        )
+        try:
+            if file_utils.atomic_write_if_absent(claim_path, payload):
+                return "won"
+            # held: by a live winner, or leaked by a dead one (kill -9
+            # mid-serve) — the lease decides, exactly like the writer
+            # and pin leases
+            try:
+                with open(claim_path, "r", encoding="utf-8") as fh:
+                    doc = json.load(fh)
+                expires = int(doc["expiresAtMs"])
+            except (OSError, ValueError, KeyError, TypeError):
+                expires = 0  # torn/vanished: treat as expired
+            if expires <= int(time.time() * 1000):
+                # takeover by atomic REPLACE, never delete+create: a
+                # delete could destroy a racing contender's fresh claim
+                # and elect two winners. Racers overwrite each other;
+                # the settle-then-verify read picks exactly one (last
+                # write) and the others keep waiting.
+                file_utils.atomic_overwrite(claim_path, payload)
+                time.sleep(0.002)
+                try:
+                    with open(claim_path, "r", encoding="utf-8") as fh:
+                        if json.load(fh).get("nonce") == nonce:
+                            return "won"
+                except (OSError, ValueError):
+                    pass
+            return "held"
+        except OSError:
+            return "error"
+
+    def _execute_pinned(self, plan, pin: Optional[Tuple]):
+        if not self._sf_enabled or not pin:
+            # unpinned/degraded serves skip the plane: their identity is
+            # not snapshot-addressed, so sharing would be unsound
+            return super()._execute_pinned(plan, pin)
+        digest = self._plan_digest(plan, pin)
+        if digest is None:
+            return super()._execute_pinned(plan, pin)
+        result_path = os.path.join(self._spool_dir, digest + ".arrow")
+        claim_path = os.path.join(self._spool_dir, digest + ".claim")
+        deadline = time.monotonic() + self._sf_wait_s
+        waiting = False
+        while True:
+            out = self._read_spool(result_path)
+            if out is not None:
+                with self._lock:
+                    self._spool_hits += 1
+                return out
+            verdict = self._try_claim(claim_path)
+            if verdict == "won":
+                with self._lock:
+                    self._claims_won += 1
+                try:
+                    out = super()._execute_pinned(plan, pin)
+                except BaseException:
+                    # free the peers immediately: a failed winner must
+                    # not make every waiter ride out the claim lease
+                    file_utils.delete(claim_path)
+                    raise
+                self._write_spool(result_path, out)
+                file_utils.delete(claim_path)
+                return out
+            if verdict == "error" or time.monotonic() >= deadline:
+                # forfeits the dedup win, never the answer
+                with self._lock:
+                    self._sf_local += 1
+                return super()._execute_pinned(plan, pin)
+            if not waiting:
+                waiting = True
+                with self._lock:
+                    self._claim_waits += 1
+            time.sleep(_SPOOL_POLL_S)
+
+    # -- introspection / lifecycle ------------------------------------------
+    def stats(self) -> dict:
+        out = super().stats()
+        with self._lock:
+            out["fleet"] = {
+                "spool_hits": self._spool_hits,
+                "claims_won": self._claims_won,
+                "claim_waits": self._claim_waits,
+                "singleflight_local": self._sf_local,
+                "bus_events": self._bus_events,
+                "bus_evicted": self._bus_evicted,
+                "bus_installed": self._bus_installed,
+                "bus_published": self._bus.published,
+            }
+        return out
+
+    def close(self, wait: bool = True) -> None:
+        self._bus.stop()
+        super().close(wait=wait)

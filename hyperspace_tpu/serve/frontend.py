@@ -86,6 +86,51 @@ def plan_fingerprint(plan: LogicalPlan) -> Tuple:
     return (repr(plan), leaves)
 
 
+class _SloClass:
+    """Per-tenant admission state (``hyperspace.fleet.class.<name>.*``,
+    docs/fleet-serve.md): ``max_concurrency`` caps how many class
+    queries RUN at once (excess admissions wait in ``pending`` without
+    occupying a worker thread), ``max_queue_depth`` sheds past that
+    backlog — both 0 = unlimited. Mutated only under the frontend
+    lock."""
+
+    __slots__ = (
+        "name",
+        "max_concurrency",
+        "max_queue_depth",
+        "running",
+        "pending",
+        "admitted",
+        "shed",
+    )
+
+    def __init__(self, name: str, max_concurrency: int, max_queue_depth: int):
+        self.name = name
+        self.max_concurrency = max_concurrency
+        self.max_queue_depth = max_queue_depth
+        self.running = 0
+        self.pending: deque = deque()
+        self.admitted = 0
+        self.shed = 0
+
+    def has_slot(self) -> bool:
+        return self.max_concurrency <= 0 or self.running < self.max_concurrency
+
+
+def _chain_future(inner: Future, outer: Future) -> None:
+    """Propagate ``inner``'s outcome onto the caller-visible ``outer``
+    (deferred SLO-class dispatch hands out ``outer`` at submit time)."""
+
+    def _done(f: Future) -> None:
+        exc = f.exception()
+        if exc is not None:
+            outer.set_exception(exc)
+        else:
+            outer.set_result(f.result())
+
+    inner.add_done_callback(_done)
+
+
 def _is_transient(exc: BaseException) -> bool:
     """Retryable? Injected faults carry the answer; every real OSError
     (missing file after a concurrent vacuum, flaky storage, Arrow I/O
@@ -122,6 +167,13 @@ class ServeFrontend:
         self._inflight: dict = {}
         self._queued = 0
         self._closed = False
+        # per-tenant SLO classes, frozen at construction like the pool
+        # size (docs/fleet-serve.md); unknown class names see only the
+        # global bounds
+        self._slo_classes = {
+            name: _SloClass(name, caps[0], caps[1])
+            for name, caps in session.conf.fleet_slo_classes.items()
+        }
         # counters (read via stats(); all mutated under _lock)
         self._admitted = 0
         self._completed = 0
@@ -163,17 +215,33 @@ class ServeFrontend:
                     time.sleep(backoff * (1 << attempt))
         return None
 
+    def _register_pins(self, pin: Optional[Tuple]) -> int:
+        """Record the pinned snapshot with the recovery plane. The fleet
+        frontend (``serve/fleet.py``) overrides this to ALSO publish a
+        lease-expiring durable pin file per index, so a GC or vacuum in
+        another process sees the pin too."""
+        return recovery.register_pins(pin)
+
     # -- admission ----------------------------------------------------------
-    def submit(self, query) -> Future:
+    def submit(self, query, slo_class: Optional[str] = None) -> Future:
         """Admit one query (DataFrame or LogicalPlan). Returns a Future
         resolving to the pyarrow Table. Raises
         :class:`ServeOverloadedError` when the pending queue is full —
-        nothing is buffered for a shed query."""
+        nothing is buffered for a shed query.
+
+        ``slo_class`` names a per-tenant admission class
+        (``hyperspace.fleet.class.<name>.*``): class queries past the
+        class ``maxQueueDepth`` shed BEFORE the global bound bites, and
+        at most ``maxConcurrency`` of them run at once — excess
+        admissions wait without occupying a worker thread, so a greedy
+        batch tier cannot starve the interactive tier's workers. An
+        unconfigured (or None) class sees only the global bounds."""
         plan = getattr(query, "logical_plan", query)
         if not isinstance(plan, LogicalPlan):
             raise HyperspaceException(
                 f"serve() takes a DataFrame or LogicalPlan, got {type(query)}"
             )
+        cls = self._slo_classes.get(slo_class) if slo_class else None
         # shed BEFORE pinning: an overloaded frontend must reject in
         # O(1) with no metadata I/O and no backoff sleeps on the caller
         # thread — that cheap typed rejection is the whole point of the
@@ -182,13 +250,13 @@ class ServeFrontend:
         # the documented contract. Depth is re-checked at enqueue (the
         # pin read dropped the lock in between).
         with self._lock:
-            self._check_admittable()
+            self._check_admittable(cls)
         pin = self._pin()
         # register the pinned snapshot's files with the recovery plane:
         # orphan GC (metadata/recovery.gc_orphans) never quarantines a
         # pinned file, so a version that goes unreferenced mid-query
         # stays readable until the query releases it (_run's finally)
-        pin_token = recovery.register_pins(pin)
+        pin_token = self._register_pins(pin)
         fp = (
             plan_fingerprint(plan),
             self._session.conf.version,
@@ -203,10 +271,23 @@ class ServeFrontend:
                     self._deduped += 1
                     recovery.release_pins(pin_token)
                     return existing
-                self._check_admittable()
+                self._check_admittable(cls)
                 self._queued += 1
                 self._admitted += 1
-                fut = self._pool.submit(self._run, plan, pin, pin_token)
+                if cls is not None:
+                    cls.admitted += 1
+                if cls is None or cls.has_slot():
+                    if cls is not None:
+                        cls.running += 1
+                    fut = self._pool.submit(
+                        self._run, plan, pin, pin_token, cls
+                    )
+                else:
+                    # class concurrency cap reached: park the admission;
+                    # a finishing class query dispatches it (the caller
+                    # holds this outer future either way)
+                    fut = Future()
+                    cls.pending.append((plan, pin, pin_token, fut))
                 self._inflight[fp] = fut
         except BaseException:
             recovery.release_pins(pin_token)
@@ -214,10 +295,25 @@ class ServeFrontend:
         fut.add_done_callback(lambda _f, fp=fp: self._forget(fp))
         return fut
 
-    def _check_admittable(self) -> None:
-        """Raise unless a new query may enter (call with the lock held)."""
+    def _check_admittable(self, cls: Optional[_SloClass] = None) -> None:
+        """Raise unless a new query may enter (call with the lock held).
+        The class bound is checked FIRST: a tenant over its own budget
+        sheds with its class named, before it can pressure the global
+        queue every other tenant shares."""
         if self._closed:
             raise HyperspaceException("ServeFrontend is closed")
+        if (
+            cls is not None
+            and cls.max_queue_depth > 0
+            and len(cls.pending) + cls.running >= cls.max_queue_depth
+        ):
+            cls.shed += 1
+            self._shed += 1
+            raise ServeOverloadedError(
+                f"SLO class {cls.name!r} queue full ({cls.running} running "
+                f"+ {len(cls.pending)} pending >= maxQueueDepth "
+                f"{cls.max_queue_depth}); shedding"
+            )
         if self._max_queue > 0 and self._queued >= self._max_queue:
             self._shed += 1
             raise ServeOverloadedError(
@@ -225,9 +321,30 @@ class ServeFrontend:
                 f"maxQueueDepth {self._max_queue}); shedding"
             )
 
-    def serve(self, query):
+    def _dispatch_pending_locked(self, cls: _SloClass) -> List[int]:
+        """Hand parked class admissions to the pool while slots are free
+        (call with the lock held). Returns the pin tokens of CANCELLED
+        parked admissions — the caller releases them outside the lock
+        (pin release is file I/O in fleet mode)."""
+        cancelled: List[int] = []
+        while cls.pending and cls.has_slot():
+            plan, pin, pin_token, outer = cls.pending.popleft()
+            # a parked outer future is a bare Future the caller may have
+            # cancelled; claim it (RUNNING blocks further cancellation)
+            # or drop the admission — a cancelled query must neither
+            # ghost-execute nor leak its pin
+            if not outer.set_running_or_notify_cancel():
+                cancelled.append(pin_token)
+                self._queued -= 1
+                continue
+            cls.running += 1
+            inner = self._pool.submit(self._run, plan, pin, pin_token, cls)
+            _chain_future(inner, outer)
+        return cancelled
+
+    def serve(self, query, slo_class: Optional[str] = None):
         """Blocking convenience: submit and wait."""
-        return self.submit(query).result()
+        return self.submit(query, slo_class=slo_class).result()
 
     def _forget(self, fp) -> None:
         with self._lock:
@@ -244,7 +361,13 @@ class ServeFrontend:
             optimized = apply_hyperspace(session, plan, entries=list(pin))
         return execute(optimized, session)
 
-    def _run(self, plan: LogicalPlan, pin: Optional[Tuple], pin_token: int):
+    def _run(
+        self,
+        plan: LogicalPlan,
+        pin: Optional[Tuple],
+        pin_token: int,
+        cls: Optional[_SloClass] = None,
+    ):
         with self._lock:
             self._queued -= 1
         session = self._session
@@ -270,7 +393,7 @@ class ServeFrontend:
                         # Swap the GC pin along with it.
                         recovery.release_pins(pin_token)
                         pin = self._pin()
-                        pin_token = recovery.register_pins(pin)
+                        pin_token = self._register_pins(pin)
                         continue
                     if isinstance(exc, OSError) and pin:
                         # persistent I/O failure of the index-rewritten
@@ -292,6 +415,12 @@ class ServeFrontend:
                     raise
         finally:
             recovery.release_pins(pin_token)
+            if cls is not None:
+                with self._lock:
+                    cls.running -= 1
+                    dropped = self._dispatch_pending_locked(cls)
+                for token in dropped:
+                    recovery.release_pins(token)
 
     def _record(self, t_start: float) -> None:
         dt = time.perf_counter() - t_start
@@ -318,6 +447,18 @@ class ServeFrontend:
                 "inflight": len(self._inflight),
                 "max_concurrency": self.max_concurrency,
             }
+            if self._slo_classes:
+                out["slo_classes"] = {
+                    name: {
+                        "admitted": cls.admitted,
+                        "shed": cls.shed,
+                        "running": cls.running,
+                        "pending": len(cls.pending),
+                        "max_concurrency": cls.max_concurrency,
+                        "max_queue_depth": cls.max_queue_depth,
+                    }
+                    for name, cls in self._slo_classes.items()
+                }
         if lat:
             out["p50_s"] = lat[len(lat) // 2]
             out["p99_s"] = lat[min(len(lat) - 1, (len(lat) * 99) // 100)]
@@ -330,6 +471,21 @@ class ServeFrontend:
     def close(self, wait: bool = True) -> None:
         with self._lock:
             self._closed = True
+            parked = [
+                item for cls in self._slo_classes.values() for item in cls.pending
+            ]
+            for cls in self._slo_classes.values():
+                cls.pending.clear()
+        # parked class admissions can never dispatch once closed: fail
+        # their futures and release their pins OUTSIDE the lock (a
+        # caller-cancelled future takes no exception — the cancel
+        # already resolved it)
+        for _plan, _pin, pin_token, outer in parked:
+            recovery.release_pins(pin_token)
+            if outer.set_running_or_notify_cancel():
+                outer.set_exception(
+                    HyperspaceException("ServeFrontend closed while queued")
+                )
         self._pool.shutdown(wait=wait)
 
     def __enter__(self) -> "ServeFrontend":

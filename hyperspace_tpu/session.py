@@ -198,16 +198,30 @@ class HyperspaceSession:
     def serve_frontend(self):
         """The session's long-lived concurrent serve frontend
         (``serve/frontend.py``): admission control, snapshot-consistent
-        pinning, retry/degrade. Created lazily; pool size is read from
-        ``hyperspace.serve.maxConcurrency`` at first touch (construct a
-        :class:`~hyperspace_tpu.serve.ServeFrontend` directly for a
-        differently-sized or short-lived one). A closed frontend is
-        discarded and replaced on the next touch — ``close()`` must not
-        brick serving on the session forever."""
+        pinning, retry/degrade. With ``hyperspace.fleet.enabled`` it is
+        a :class:`~hyperspace_tpu.serve.fleet.FleetFrontend` — the same
+        surface plus durable cross-process pins, fanout-bus
+        subscription and cross-process single-flight
+        (docs/fleet-serve.md). Created lazily; pool size, SLO classes
+        and the fleet flag are read at first touch (construct a
+        frontend directly for a differently-configured or short-lived
+        one). A closed — or mode-mismatched, after a fleet-flag flip —
+        frontend is discarded and replaced on the next touch;
+        ``close()`` must not brick serving on the session forever."""
         with self._serve_frontend_lock:
-            if self._serve_frontend is None or self._serve_frontend.closed:
-                from hyperspace_tpu.serve import ServeFrontend
+            from hyperspace_tpu.serve import ServeFrontend
 
+            fe = self._serve_frontend
+            if self.conf.fleet_enabled:
+                from hyperspace_tpu.serve.fleet import FleetFrontend
+
+                if fe is None or fe.closed or not isinstance(fe, FleetFrontend):
+                    if fe is not None and not fe.closed:
+                        fe.close(wait=False)
+                    self._serve_frontend = FleetFrontend(self)
+            elif fe is None or fe.closed or type(fe) is not ServeFrontend:
+                if fe is not None and not fe.closed:
+                    fe.close(wait=False)
                 self._serve_frontend = ServeFrontend(self)
             return self._serve_frontend
 

@@ -57,6 +57,9 @@ HS_BENCH_REPS="${HS_BENCH_REPS:-2}" \
 HS_BENCH_LADDER="$ROWS" \
 HS_BENCH_MESH="${HS_BENCH_MESH:-1,8}" \
 HS_BENCH_MESH_ROWS="$ROWS" \
+HS_BENCH_FLEET="${HS_BENCH_FLEET:-2}" \
+HS_BENCH_FLEET_ITERS="${HS_BENCH_FLEET_ITERS:-4}" \
+HS_BENCH_FLEET_ROWS="${HS_BENCH_FLEET_ROWS:-20000}" \
 python bench.py)
 echo "$OUT"
 # the pruned filter path must actually have run: the z-order row's
@@ -128,6 +131,26 @@ assert ch["orphans_after_gc"] == 0, ch
 assert ch["serve_mismatches"] == 0, ch
 assert ch["serves_verified"] >= 1, ch
 print("bench_smoke: chaos recovery ok:", ch, file=sys.stderr)
+# the multi-process fleet (serve/fleet.py, docs/fleet-serve.md): N real
+# frontend processes over one lake — every rung must report ZERO wrong
+# answers, ZERO leaked pin files and a POSITIVE cross-process dedup
+# count (identical plans at N processes single-flighted to one
+# execution), and the chaos rung must have kill -9ed a frontend
+# mid-serve with the survivors still bit-identical
+fl = d["fleet_ladder"]
+assert fl, "fleet ladder rows missing"
+for r in fl:
+    assert r["wrong_answers"] == 0, r
+    assert r["leaked_pin_files"] == 0, r
+    assert r["cross_process_dedup"] > 0, r
+    assert r["qps"] > 0 and r["workers_reporting"] == r["processes"], r
+fc = d["fleet_chaos"]
+assert fc["killed"], fc
+assert fc["workers_reporting"] == fc["processes"] - 1, fc
+assert fc["wrong_answers"] == 0 and fc["leaked_pin_files"] == 0, fc
+print("bench_smoke: fleet ok:",
+      [(r["processes"], r["qps"], r["cross_process_dedup"]) for r in fl],
+      "chaos:", (fc["processes"], fc["workers_reporting"]), file=sys.stderr)
 print("bench_smoke: serve concurrency ok:",
       {c: (sc[c]["p50_ms"], sc[c]["p99_ms"], sc[c]["qps"]) for c in sc},
       file=sys.stderr)

@@ -673,8 +673,9 @@ class TestApproxPlane:
             with pytest.raises(ApproximationError):
                 df.filter(df["c"] >= 0).agg(F.min("v").alias("m")).collect_approx()
             with pytest.raises(ApproximationError):
-                # grouped: not estimable
-                df.filter(df["c"] >= 0).group_by("p").agg(
+                # MULTI-key grouped: not estimable (single-key is — see
+                # test_grouped_estimates_with_per_group_cis)
+                df.filter(df["c"] >= 0).group_by("p", "c").agg(
                     F.count().alias("n")
                 ).collect_approx()
         finally:
@@ -690,6 +691,56 @@ class TestApproxPlane:
                 # a near-empty selection: CI half-width dwarfs the tiny
                 # estimate, the budget must reject it
                 df.filter(df["c"] < 3).agg(
+                    F.count().alias("n")
+                ).collect_approx(max_rel_error=0.01)
+        finally:
+            s1.conf.unset(C.SERVE_APPROX_ENABLED)
+            s1.disable_hyperspace()
+
+    def test_grouped_estimates_with_per_group_cis(self, s1, tmp_path):
+        """Single-key grouped COUNT/SUM: one row per observed group,
+        key-sorted, each with its own 95% interval — and the intervals
+        contain the exact answers (a seeded check, not probabilistic
+        hand-waving: this seed's sample is fixed)."""
+        hs, df = self._mk(s1, tmp_path)
+        s1.enable_hyperspace()
+        s1.conf.set(C.SERVE_APPROX_ENABLED, True)
+        try:
+            q = df.filter(df["c"] < 60_000).group_by("p").agg(
+                F.count().alias("n"), F.sum("v").alias("sv")
+            )
+            approx = q.collect_approx(max_rel_error=0.9)
+            exact = q.collect().sort_by([("p", "ascending")])
+            assert approx.column_names == ["p", "n", "n_lo", "n_hi", "sv", "sv_lo", "sv_hi"]
+            assert approx.column("p").to_pylist() == exact.column("p").to_pylist()
+            an = approx.to_pydict()
+            en = exact.to_pydict()
+            held = sum(
+                1
+                for i in range(len(an["p"]))
+                if an["n_lo"][i] <= en["n"][i] <= an["n_hi"][i]
+            )
+            # 95% intervals over 6 groups: tolerate one miss, no more
+            assert held >= len(an["p"]) - 1, (an, en)
+            for i in range(len(an["p"])):
+                assert an["n_lo"][i] <= an["n"][i] <= an["n_hi"][i]
+                assert an["sv_lo"][i] <= an["sv"][i] <= an["sv_hi"][i]
+            # estimates are float64 — never mistakable for exact ints
+            assert approx.schema.field("n").type == pa.float64()
+        finally:
+            s1.conf.unset(C.SERVE_APPROX_ENABLED)
+            s1.disable_hyperspace()
+
+    def test_grouped_budget_applies_per_group(self, s1, tmp_path):
+        """A budget every group must hold: a rare group's wide interval
+        rejects the whole answer rather than shipping one over-trusted
+        row."""
+        hs, df = self._mk(s1, tmp_path)
+        s1.enable_hyperspace()
+        s1.conf.set(C.SERVE_APPROX_ENABLED, True)
+        try:
+            with pytest.raises(ApproximationError):
+                df.filter(df["c"] < 60_000).group_by("p").agg(
                     F.count().alias("n")
                 ).collect_approx(max_rel_error=0.01)
         finally:

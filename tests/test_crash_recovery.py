@@ -894,3 +894,139 @@ class TestSubprocessCrash:
         assert log_mgr.get_latest_stable_pointer_id() == tip_id
         assert recovery.find_orphans(log_mgr.index_path) == []
         assert_serve_matches_source(s, src)
+
+
+# ---------------------------------------------------------------------------
+# Durable cross-process pins (fleet mode, docs/fleet-serve.md): a pin
+# registered by process A must survive a GC/vacuum driven from process B
+# until A's lease expires; expired pins are reaped and the file set
+# converges.
+# ---------------------------------------------------------------------------
+
+
+class TestCrossProcessPins:
+    def _mk_index(self, env):
+        s, hs, src = env
+        df = s.read.parquet(src)
+        hs.create_index(df, CoveringIndexConfig("idx", ["clicks"], ["query"]))
+        log_mgr, _ = s.index_manager._managers("idx")
+        return s, hs, src, log_mgr
+
+    def _as_process_b(self, monkeypatch):
+        """Simulate the GC/vacuum running in ANOTHER process: process
+        B's in-memory pin registry is empty — only the durable pin
+        files on disk can speak for A's live queries."""
+        monkeypatch.setattr(recovery, "_active_pins", {})
+
+    def test_pin_file_published_and_released(self, env):
+        s, hs, src, log_mgr = self._mk_index(env)
+        entries = s.index_manager.get_indexes([States.ACTIVE])
+        token = recovery.register_pins(entries, durable=True, lease_ms=5_000)
+        pins_dir = os.path.join(log_mgr.index_path, C.HYPERSPACE_PINS_DIR)
+        names = os.listdir(pins_dir)
+        assert len(names) == 1 and names[0].endswith(".json")
+        assert recovery.durable_pinned_files(log_mgr.index_path) == {
+            p.replace("\\", "/") for p in entries[0].content.files
+        }
+        recovery.release_pins(token)
+        assert recovery.durable_pinned_files(log_mgr.index_path) == set()
+        assert not os.path.isdir(pins_dir) or not os.listdir(pins_dir)
+
+    def test_gc_from_process_b_respects_live_pin(self, env, monkeypatch):
+        s, hs, src, log_mgr = self._mk_index(env)
+        index_path = log_mgr.index_path
+        # strand an orphan and pin it durably, as process A's live
+        # query would
+        orphan_dir = os.path.join(index_path, "v__=9")
+        os.makedirs(orphan_dir)
+        orphan = os.path.join(orphan_dir, "part-orphan.parquet")
+        with open(orphan, "w") as f:
+            f.write("x")
+        from hyperspace_tpu.metadata.entry import Content
+
+        entry = log_mgr.get_latest_stable_log().copy()
+        entry.content = Content.from_leaf_files([(orphan, 1, 1)])
+        token = recovery.register_pins(
+            [entry], durable=True, lease_ms=60_000, heartbeat=False
+        )
+        self._as_process_b(monkeypatch)
+        rep = recovery.gc_orphans(index_path, grace_ms=0)
+        assert rep["kept_pinned"] == 1 and os.path.isfile(orphan)
+        assert rep["reaped_pins"] == 0
+        # A's lease expires (its heartbeat died with it): the pin file
+        # is reaped and the file set converges on the next pass
+        rep = recovery.gc_orphans(
+            index_path, grace_ms=0, now=recovery.now_ms() + 120_000
+        )
+        assert rep["reaped_pins"] == 1
+        assert rep["quarantined_dirs"] == 1
+        assert not os.path.exists(orphan)
+        pins_dir = os.path.join(index_path, C.HYPERSPACE_PINS_DIR)
+        assert not os.path.isdir(pins_dir) or not os.listdir(pins_dir)
+        # convergence: a further pass finds nothing
+        rep = recovery.gc_orphans(index_path, grace_ms=0)
+        assert rep["quarantined_files"] == 0 and rep["quarantined_dirs"] == 0
+        recovery.release_pins(token)
+
+    def test_vacuum_from_process_b_respects_live_pin(
+        self, env, monkeypatch
+    ):
+        s, hs, src, log_mgr = self._mk_index(env)
+        index_path = log_mgr.index_path
+        old_files = set(log_mgr.get_latest_stable_log().content.files)
+        # pin the CURRENT (soon-to-be-outdated) version durably, as a
+        # mid-serve query in process A would
+        entries = s.index_manager.get_indexes([States.ACTIVE])
+        token = recovery.register_pins(
+            entries, durable=True, lease_ms=60_000, heartbeat=False
+        )
+        # a full refresh supersedes the pinned version...
+        append_file(src)
+        hs.refresh_index("idx", "full")
+        # ...and process B vacuums the outdated versions
+        self._as_process_b(monkeypatch)
+        hs.vacuum_index("idx")
+        for p in old_files:
+            assert os.path.isfile(p), f"vacuum deleted pinned file {p}"
+        # A dies (kill -9): its heartbeat stops and the lease runs out —
+        # simulated by restamping the pin file already-expired
+        import json as _json
+
+        pins_dir = os.path.join(index_path, C.HYPERSPACE_PINS_DIR)
+        for name in os.listdir(pins_dir):
+            p = os.path.join(pins_dir, name)
+            with open(p) as fh:
+                doc = _json.load(fh)
+            doc["expiresAtMs"] = recovery.now_ms() - 1
+            with open(p, "w") as fh:
+                _json.dump(doc, fh)
+        # B's retried vacuum now deletes the leftovers and reaps the pin
+        hs.vacuum_index("idx")
+        for p in old_files:
+            assert not os.path.exists(p)
+        assert not os.path.isdir(pins_dir) or not os.listdir(pins_dir)
+        assert recovery.find_orphans(index_path) == []
+        assert_serve_matches_source(s, src)
+        recovery.release_pins(token)
+
+    def test_heartbeat_keeps_pin_alive(self, env):
+        s, hs, src, log_mgr = self._mk_index(env)
+        entries = s.index_manager.get_indexes([States.ACTIVE])
+        token = recovery.register_pins(entries, durable=True, lease_ms=60)
+        pins_dir = os.path.join(log_mgr.index_path, C.HYPERSPACE_PINS_DIR)
+        name = os.listdir(pins_dir)[0]
+        # several lease periods later the file is still unexpired: the
+        # heartbeat has been renewing it
+        time.sleep(0.25)
+        assert recovery.durable_pinned_files(log_mgr.index_path)
+        assert os.listdir(pins_dir) == [name]
+        recovery.release_pins(token)
+
+    def test_torn_pin_file_is_reaped(self, env):
+        s, hs, src, log_mgr = self._mk_index(env)
+        pins_dir = os.path.join(log_mgr.index_path, C.HYPERSPACE_PINS_DIR)
+        os.makedirs(pins_dir, exist_ok=True)
+        with open(os.path.join(pins_dir, "dead.1.json"), "w") as f:
+            f.write('{"owner": "dead", "expi')  # torn
+        assert recovery.durable_pinned_files(log_mgr.index_path) == set()
+        assert not os.path.isdir(pins_dir) or not os.listdir(pins_dir)

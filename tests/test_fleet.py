@@ -1,0 +1,529 @@
+"""Replicated serve fleet (docs/fleet-serve.md): durable pins, version
+fanout, cross-process single-flight, per-tenant SLO classes.
+
+The durable-pin × GC/vacuum interaction lives in
+``tests/test_crash_recovery.py`` (``TestCrossProcessPins``); this file
+covers the serve-tier planes — the bus, the claim/spool single-flight
+(driven through two in-process ``FleetFrontend`` instances, which share
+NO in-process state by construction, so the file protocol is what
+coordinates them), the SLO-class scheduler, and (slow) the real
+multi-process harness with its kill -9 rung.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu import functions as F
+from hyperspace_tpu.exceptions import ServeOverloadedError
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+from hyperspace_tpu.serve.bus import FleetBus
+from hyperspace_tpu.serve.fleet import FleetFrontend, spool_dir
+from hyperspace_tpu.serve.frontend import ServeFrontend
+
+
+def sorted_table(t):
+    return t.sort_by([(c, "ascending") for c in t.column_names])
+
+
+@pytest.fixture
+def fleet_env(tmp_path):
+    """One lake + two fleet sessions over it (the in-process stand-in
+    for two frontend processes: separate sessions, separate caches,
+    coordination only through the lake's files)."""
+    from hyperspace_tpu.session import HyperspaceSession
+
+    src = tmp_path / "src"
+    src.mkdir()
+    rng = np.random.default_rng(7)
+    n = 4000
+    pq.write_table(
+        pa.table(
+            {
+                "k": pa.array(rng.integers(0, 60, n), pa.int64()),
+                "v": pa.array(rng.integers(-500, 500, n), pa.int64()),
+            }
+        ),
+        str(src / "part-0.parquet"),
+    )
+    index_root = str(tmp_path / "indexes")
+
+    def make_session(**conf):
+        s = HyperspaceSession()
+        s.conf.set(C.INDEX_SYSTEM_PATH, index_root)
+        s.conf.set(C.INDEX_NUM_BUCKETS, 4)
+        s.conf.set(C.FLEET_ENABLED, True)
+        s.conf.set(C.SERVE_CACHE_ENABLED, True)
+        s.conf.set(C.FLEET_BUS_POLL_MS, 20)
+        for k, v in conf.items():
+            s.conf.set(k, v)
+        s.enable_hyperspace()
+        return s
+
+    s1 = make_session()
+    hs1 = Hyperspace(s1)
+    df = s1.read.parquet(str(src))
+    hs1.create_index(df, CoveringIndexConfig("fidx", ["k"], ["v"]))
+    return {
+        "src": str(src),
+        "index_root": index_root,
+        "make_session": make_session,
+        "s1": s1,
+        "hs1": hs1,
+        "rng": rng,
+    }
+
+
+# ---------------------------------------------------------------------------
+# The fanout bus
+# ---------------------------------------------------------------------------
+
+
+class TestFleetBus:
+    def test_publish_poll_roundtrip(self, tmp_path):
+        d = str(tmp_path / "bus")
+        a = FleetBus(d, retain_ms=60_000)
+        b = FleetBus(d, retain_ms=60_000)
+        b.prime()
+        a.publish({"type": "index_changed", "root": "/x"})
+        a.publish({"type": "index_changed", "root": "/y"})
+        events = b.poll_once()
+        assert [e["root"] for e in events] == ["/x", "/y"]
+        assert b.poll_once() == []  # seen once
+        assert b.received == 2
+
+    def test_own_events_skipped(self, tmp_path):
+        d = str(tmp_path / "bus")
+        a = FleetBus(d)
+        a.prime()
+        a.publish({"type": "index_changed", "root": "/x"})
+        assert a.poll_once() == []
+
+    def test_prime_skips_history(self, tmp_path):
+        d = str(tmp_path / "bus")
+        a = FleetBus(d)
+        a.publish({"type": "index_changed", "root": "/old"})
+        b = FleetBus(d)
+        b.prime()
+        assert b.poll_once() == []
+        a.publish({"type": "index_changed", "root": "/new"})
+        assert [e["root"] for e in b.poll_once()] == ["/new"]
+
+    def test_retention_prune(self, tmp_path):
+        d = str(tmp_path / "bus")
+        a = FleetBus(d, retain_ms=80)
+        a.publish({"type": "index_changed", "root": "/x"})
+        time.sleep(0.15)
+        a.publish({"type": "index_changed", "root": "/y"})
+        assert a.pruned >= 1
+        names = [f for f in os.listdir(d) if f.endswith(".json")]
+        assert len(names) == 1
+
+    def test_torn_event_skipped(self, tmp_path):
+        d = str(tmp_path / "bus")
+        os.makedirs(d)
+        b = FleetBus(d)
+        b.prime()
+        with open(os.path.join(d, "9999999999999.dead.000001.json"), "w") as f:
+            f.write('{"type": "ind')
+        assert b.poll_once() == []
+
+    def test_subscriber_thread_delivers(self, tmp_path):
+        d = str(tmp_path / "bus")
+        got = []
+        done = threading.Event()
+        b = FleetBus(d, poll_ms=10)
+        b.start(lambda e: (got.append(e), done.set()))
+        try:
+            FleetBus(d).publish({"type": "index_changed", "root": "/z"})
+            assert done.wait(5.0)
+            assert got[0]["root"] == "/z"
+        finally:
+            b.stop()
+
+
+# ---------------------------------------------------------------------------
+# ServeCache fanout eviction
+# ---------------------------------------------------------------------------
+
+
+class TestEvictPathsUnder:
+    def test_evicts_only_matching_index(self):
+        from hyperspace_tpu.execution.serve_cache import ServeCache
+
+        c = ServeCache(1 << 20)
+        fp_a = (("/lake/idxA/v__=1/part-0.parquet", 10, 1),)
+        fp_b = (("/lake/idxB/v__=1/part-0.parquet", 10, 1),)
+        c.put(("scan", fp_a), "a", 10)
+        c.put(("zonemap", fp_a), "za", 10)
+        c.put(("joinside", (fp_a, fp_b), ("k",), ("k",)), "j", 10)
+        c.put(("scan", fp_b), "b", 10)
+        assert c.evict_paths_under("/lake/idxA") == 3
+        assert c.get(("scan", fp_b)) == "b"
+        assert c.get(("scan", fp_a)) is None
+        assert c.resident_bytes == 10
+
+
+# ---------------------------------------------------------------------------
+# Aggstate push payloads (ROADMAP 2c)
+# ---------------------------------------------------------------------------
+
+
+class TestAggstatePush:
+    def test_payload_roundtrip(self, fleet_env):
+        from hyperspace_tpu.execution.serve_cache import ServeCache
+        from hyperspace_tpu.indexes import aggindex
+
+        s1 = fleet_env["s1"]
+        entries = s1.index_manager.get_indexes([C.States.ACTIVE])
+        files = entries[0].content.files
+        payload = aggindex.fanout_payload(files)
+        assert payload is not None
+        # JSON round trip, as the bus would carry it
+        payload = json.loads(json.dumps(payload))
+        cache = ServeCache(1 << 24)
+        aggindex.invalidate_local_cache()
+        assert aggindex.install_fanout_payload(payload, cache)
+        assert cache.bytes_by_kind().get("aggstate", 0) > 0
+
+    def test_stale_payload_dropped(self, fleet_env):
+        from hyperspace_tpu.indexes import aggindex
+
+        s1 = fleet_env["s1"]
+        entries = s1.index_manager.get_indexes([C.States.ACTIVE])
+        payload = aggindex.fanout_payload(entries[0].content.files)
+        payload["fp"][0][1] += 1  # stats moved on: stale push
+        assert not aggindex.install_fanout_payload(payload, None)
+
+    def test_refresh_fans_out_to_peer(self, fleet_env):
+        src, rng = fleet_env["src"], fleet_env["rng"]
+        s2 = fleet_env["make_session"]()
+        fe2 = s2.serve_frontend
+        try:
+            assert isinstance(fe2, FleetFrontend)
+            pq.write_table(
+                pa.table(
+                    {
+                        "k": pa.array(rng.integers(0, 60, 500), pa.int64()),
+                        "v": pa.array(
+                            rng.integers(-500, 500, 500), pa.int64()
+                        ),
+                    }
+                ),
+                os.path.join(src, "part-1.parquet"),
+            )
+            fleet_env["hs1"].refresh_index("fidx", "incremental")
+            # wait on bus_installed, not bus_events: the callback counts
+            # the event BEFORE it installs the payload
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                st = fe2.stats()["fleet"]
+                if st["bus_installed"] >= 1:
+                    break
+                time.sleep(0.02)
+            st = fe2.stats()["fleet"]
+            assert st["bus_events"] >= 1, st
+            assert st["bus_installed"] >= 1, st
+            # the peer serves the NEW snapshot correctly
+            df = s2.read.parquet(src)
+            q = df.filter(df["k"] >= 10).agg(F.count().alias("n"))
+            got = fe2.serve(q)
+            s2.disable_hyperspace()
+            want = q.collect()
+            s2.enable_hyperspace()
+            assert got.equals(want)
+        finally:
+            fe2.close()
+
+
+# ---------------------------------------------------------------------------
+# Cross-process single-flight (claim + spool)
+# ---------------------------------------------------------------------------
+
+
+class TestSingleFlight:
+    def test_two_frontends_one_execution(self, fleet_env):
+        s1 = fleet_env["s1"]
+        s2 = fleet_env["make_session"]()
+        fe1, fe2 = s1.serve_frontend, s2.serve_frontend
+        try:
+            src = fleet_env["src"]
+            q1 = s1.read.parquet(src)
+            q1 = q1.filter(q1["k"] == 11)
+            q2 = s2.read.parquet(src)
+            q2 = q2.filter(q2["k"] == 11)
+            t1 = fe1.serve(q1)
+            t2 = fe2.serve(q2)
+            assert sorted_table(t1).equals(sorted_table(t2))
+            st1, st2 = fe1.stats()["fleet"], fe2.stats()["fleet"]
+            assert st1["claims_won"] + st2["claims_won"] == 1
+            assert st1["spool_hits"] + st2["spool_hits"] == 1
+            # the answer is correct vs the unindexed truth
+            s1.disable_hyperspace()
+            want = q1.collect()
+            s1.enable_hyperspace()
+            assert sorted_table(t1).equals(sorted_table(want))
+        finally:
+            fe1.close()
+            fe2.close()
+
+    def test_expired_claim_taken_over(self, fleet_env):
+        s2 = fleet_env["make_session"]()
+        s2.conf.set(C.FLEET_SINGLEFLIGHT_CLAIM_MS, 30)
+        fe2 = s2.serve_frontend
+        try:
+            # a dead winner's claim (kill -9 mid-serve) sits in the
+            # spool; its lease expires and fe2 takes the claim over
+            claim = os.path.join(spool_dir(s2.conf), "deadbeef.claim")
+            os.makedirs(os.path.dirname(claim), exist_ok=True)
+            with open(claim, "w") as f:
+                json.dump({"owner": "dead", "expiresAtMs": 1}, f)
+            assert fe2._try_claim(claim) == "won"
+            # a LIVE claim is respected
+            claim2 = os.path.join(spool_dir(s2.conf), "cafebabe.claim")
+            with open(claim2, "w") as f:
+                json.dump(
+                    {
+                        "owner": "live",
+                        "expiresAtMs": int(time.time() * 1000) + 600_000,
+                    },
+                    f,
+                )
+            assert fe2._try_claim(claim2) == "held"
+        finally:
+            fe2.close()
+
+    def test_wait_timeout_executes_locally(self, fleet_env):
+        s2 = fleet_env["make_session"]()
+        s2.conf.set(C.FLEET_SINGLEFLIGHT_WAIT_MS, 50)
+        s2.conf.set(C.FLEET_SINGLEFLIGHT_CLAIM_MS, 600_000)
+        fe2 = s2.serve_frontend
+        try:
+            src = fleet_env["src"]
+            q = s2.read.parquet(src)
+            q = q.filter(q["k"] == 31)
+            pin = fe2._pin()
+            digest = fe2._plan_digest(q.logical_plan, pin)
+            claim = os.path.join(spool_dir(s2.conf), digest + ".claim")
+            os.makedirs(os.path.dirname(claim), exist_ok=True)
+            with open(claim, "w") as f:
+                json.dump(
+                    {
+                        "owner": "live-elsewhere",
+                        "expiresAtMs": int(time.time() * 1000) + 600_000,
+                    },
+                    f,
+                )
+            t = fe2.serve(q)  # waits 50ms, then serves locally
+            s2.disable_hyperspace()
+            want = q.collect()
+            s2.enable_hyperspace()
+            assert sorted_table(t).equals(sorted_table(want))
+            st = fe2.stats()["fleet"]
+            assert st["singleflight_local"] >= 1, st
+            assert st["claim_waits"] >= 1, st
+        finally:
+            fe2.close()
+
+    def test_spool_prune_respects_budget(self, fleet_env):
+        s2 = fleet_env["make_session"]()
+        s2.conf.set(C.FLEET_SPOOL_MAX_BYTES, 1)
+        fe2 = s2.serve_frontend
+        try:
+            src = fleet_env["src"]
+            q = s2.read.parquet(src)
+            q = q.filter(q["k"] == 42)
+            fe2.serve(q)
+            sd = spool_dir(s2.conf)
+            arrows = [f for f in os.listdir(sd) if f.endswith(".arrow")]
+            assert arrows == []  # over-budget results pruned immediately
+        finally:
+            fe2.close()
+
+
+# ---------------------------------------------------------------------------
+# Per-tenant SLO classes
+# ---------------------------------------------------------------------------
+
+
+class TestSloClasses:
+    def _frontend(self, fleet_env, **conf):
+        s = fleet_env["make_session"](**{C.FLEET_ENABLED: False, **conf})
+        return s, ServeFrontend(s)
+
+    def test_class_max_concurrency_gates_running(self, fleet_env):
+        s, fe = self._frontend(
+            fleet_env,
+            **{
+                C.FLEET_CLASS_KEY_PREFIX + "batch.maxConcurrency": 1,
+                C.SERVE_MAX_CONCURRENCY: 8,
+            },
+        )
+        try:
+            gate = threading.Event()
+            running = []
+
+            def slow_exec(plan, pin):
+                running.append(1)
+                assert gate.wait(10.0)
+                return pa.table({"x": pa.array([len(running)])})
+
+            fe._execute_pinned = slow_exec
+            src = fleet_env["src"]
+            futs = []
+            for i in range(4):
+                q = s.read.parquet(src)
+                q = q.filter(q["k"] == i)  # distinct plans: no dedup
+                futs.append(fe.submit(q, slo_class="batch"))
+            time.sleep(0.2)
+            st = fe.stats()["slo_classes"]["batch"]
+            assert st["running"] == 1, st
+            assert st["pending"] == 3, st
+            assert len(running) == 1
+            gate.set()
+            for f in futs:
+                f.result(timeout=10)
+            st = fe.stats()["slo_classes"]["batch"]
+            assert st["running"] == 0 and st["pending"] == 0
+            assert st["admitted"] == 4
+        finally:
+            fe.close()
+
+    def test_batch_sheds_before_interactive(self, fleet_env):
+        s, fe = self._frontend(
+            fleet_env,
+            **{
+                C.FLEET_CLASS_KEY_PREFIX + "batch.maxConcurrency": 1,
+                C.FLEET_CLASS_KEY_PREFIX + "batch.maxQueueDepth": 2,
+                C.SERVE_MAX_CONCURRENCY: 8,
+                C.SERVE_MAX_QUEUE_DEPTH: 64,
+            },
+        )
+        try:
+            gate = threading.Event()
+            fe._execute_pinned = lambda plan, pin: (
+                gate.wait(10.0),
+                pa.table({"x": pa.array([1])}),
+            )[1]
+            src = fleet_env["src"]
+
+            def q(i):
+                df = s.read.parquet(src)
+                return df.filter(df["k"] == i)
+
+            futs = [fe.submit(q(i), slo_class="batch") for i in range(2)]
+            # the batch tier is at its depth: the third submit sheds...
+            with pytest.raises(ServeOverloadedError, match="batch"):
+                fe.submit(q(99), slo_class="batch")
+            # ...while the interactive tier (and unclassed traffic) is
+            # untouched by batch pressure
+            f_int = fe.submit(q(7), slo_class="interactive")
+            f_un = fe.submit(q(8))
+            gate.set()
+            for f in futs + [f_int, f_un]:
+                f.result(timeout=10)
+            st = fe.stats()
+            assert st["slo_classes"]["batch"]["shed"] == 1
+            assert st["shed"] == 1
+        finally:
+            fe.close()
+
+    def test_unconfigured_class_unlimited(self, fleet_env):
+        s, fe = self._frontend(fleet_env)
+        try:
+            src = fleet_env["src"]
+            q = s.read.parquet(src)
+            q = q.filter(q["k"] == 3)
+            t = fe.serve(q, slo_class="nosuch")
+            assert t.num_rows >= 0
+            assert "slo_classes" not in fe.stats()
+        finally:
+            fe.close()
+
+    def test_close_fails_parked_admissions(self, fleet_env):
+        s, fe = self._frontend(
+            fleet_env,
+            **{C.FLEET_CLASS_KEY_PREFIX + "batch.maxConcurrency": 1},
+        )
+        gate = threading.Event()
+        fe._execute_pinned = lambda plan, pin: (
+            gate.wait(10.0),
+            pa.table({"x": pa.array([1])}),
+        )[1]
+        src = fleet_env["src"]
+
+        def q(i):
+            df = s.read.parquet(src)
+            return df.filter(df["k"] == i)
+
+        f0 = fe.submit(q(0), slo_class="batch")
+        f1 = fe.submit(q(1), slo_class="batch")  # parked
+        gate.set()
+        f0.result(timeout=10)
+        fe.close(wait=False)
+        # the parked admission either dispatched before close (ran) or
+        # was failed with a typed error — never silently dropped
+        try:
+            f1.result(timeout=10)
+        except Exception as exc:
+            assert "closed" in str(exc).lower()
+
+
+# ---------------------------------------------------------------------------
+# Session integration
+# ---------------------------------------------------------------------------
+
+
+class TestSessionIntegration:
+    def test_frontend_type_follows_fleet_flag(self, fleet_env):
+        s = fleet_env["make_session"]()
+        fe = s.serve_frontend
+        assert isinstance(fe, FleetFrontend)
+        s.conf.set(C.FLEET_ENABLED, False)
+        fe2 = s.serve_frontend
+        assert type(fe2) is ServeFrontend
+        assert fe.closed  # the mode-mismatched frontend was retired
+        s.conf.set(C.FLEET_ENABLED, True)
+        fe3 = s.serve_frontend
+        assert isinstance(fe3, FleetFrontend)
+        fe3.close()
+
+
+# ---------------------------------------------------------------------------
+# The real thing: N OS processes over one lake (slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestFleetProcesses:
+    def test_two_processes_single_flight_and_convergence(self, tmp_path):
+        from hyperspace_tpu.testing import fleet_harness
+
+        rep = fleet_harness.run_fleet(
+            str(tmp_path / "fleet"), n_procs=2, iters=3, rows=8000
+        )
+        assert rep["wrong_answers"] == 0
+        assert rep["cross_process_dedup"] > 0
+        assert rep["leaked_pin_files"] == 0
+
+    def test_kill_nine_mid_serve(self, tmp_path):
+        from hyperspace_tpu.testing import fleet_harness
+
+        rep = fleet_harness.run_fleet(
+            str(tmp_path / "chaos"),
+            n_procs=3,
+            iters=3,
+            rows=8000,
+            kill_one=True,
+        )
+        assert rep["killed"] and rep["workers_reporting"] == 2
+        assert rep["wrong_answers"] == 0
+        assert rep["leaked_pin_files"] == 0
