@@ -519,6 +519,79 @@ def main() -> None:
                 f"{row['cache_high_water_bytes'] / 1e6:.0f}MB)"
             )
 
+        # --- obs plane A/B (hyperspace_tpu/obs/, docs/observability.md):
+        # the SAME 8-client rung with tracing+querylog ON vs OFF,
+        # interleaved on/off/on/off so drift hits both legs equally.
+        # The on legs additionally prove the structural contract
+        # bench_smoke.sh gates on: every EXECUTION yields exactly one
+        # root span, and the querylog gains exactly one schema-valid
+        # row per execution (deduped submits share the winner's trace).
+        from hyperspace_tpu.obs import querylog as _oql
+        from hyperspace_tpu.obs import trace as _otr
+
+        obs_dir = _oql.obs_root(session.conf)
+        obs_legs = {"on": [], "off": []}
+        obs_roots = obs_rows_written = obs_executions = 0
+        session.conf.set(C.OBS_TRACE_RETAIN, 4096)
+        for leg in ("on", "off", "on", "off"):
+            session.conf.set(C.OBS_ENABLED, leg == "on")
+            _otr.reset()
+            rows_before = len(_oql.read_records(obs_dir))
+            row = serve_rung(8)
+            obs_legs[leg].append(row)
+            if leg == "on":
+                executions = row["queries"] - row["deduped"]
+                roots = _otr.finished("serve.query")
+                all_rows = _oql.read_records(obs_dir)
+                rows_now = len(all_rows)
+                assert len(roots) == executions, (len(roots), executions)
+                for r in roots:
+                    assert r.attrs.get("status") == "ok", r.attrs
+                assert rows_now - rows_before == executions, (
+                    rows_now, rows_before, executions,
+                )
+                root_ids = {r.trace_id for r in roots}
+                new_rows = [
+                    rec
+                    for rec in all_rows
+                    if rec.get("trace_id") in root_ids
+                ]
+                assert len(new_rows) == executions
+                for rec in new_rows:
+                    err = _oql.validate_record(rec)
+                    assert err is None, (err, rec)
+                obs_roots += len(roots)
+                obs_rows_written += rows_now - rows_before
+                obs_executions += executions
+        session.conf.set(C.OBS_ENABLED, False)
+        _otr.set_enabled(False)
+        _otr.reset()
+        obs_p50_on = float(
+            np.median([r["p50_ms"] for r in obs_legs["on"]])
+        )
+        obs_p50_off = float(
+            np.median([r["p50_ms"] for r in obs_legs["off"]])
+        )
+        obs_overhead = obs_p50_on / max(obs_p50_off, 1e-9) - 1.0
+        serve_obs = {
+            "p50_on_ms": round(obs_p50_on, 2),
+            "p50_off_ms": round(obs_p50_off, 2),
+            "overhead_ratio": round(obs_overhead, 4),
+            "roots": obs_roots,
+            "querylog_rows": obs_rows_written,
+            "executions": obs_executions,
+        }
+        if n_items >= 4_000_000:
+            # the acceptance bar holds at the real rung; tiny smoke
+            # rows are noise-dominated and only gate the structure
+            assert obs_overhead <= 0.05, serve_obs
+        log(
+            f"obs A/B: p50 on {serve_obs['p50_on_ms']}ms / off "
+            f"{serve_obs['p50_off_ms']}ms ({obs_overhead * 100:+.1f}%), "
+            f"{obs_roots} roots == {obs_executions} executions, "
+            f"{obs_rows_written} querylog rows"
+        )
+
         # --- fault-injection rung (testing/faults.py): one serve per
         # injection point x {transient, persistent}, each differential
         # against the fault-free result — the bench-level witness that
@@ -1195,6 +1268,7 @@ def main() -> None:
                         join_raw["p50"] / join_cached["p50"], 3
                     ),
                     "serve_concurrency": serve_concurrency,
+                    "serve_obs": serve_obs,
                     "fleet_ladder": fleet_ladder,
                     "fleet_chaos": fleet_chaos,
                     "fleet_vs_64client_qps": round(

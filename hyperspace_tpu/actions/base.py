@@ -60,6 +60,7 @@ from hyperspace_tpu.exceptions import (
 )
 from hyperspace_tpu.metadata.entry import IndexLogEntry
 from hyperspace_tpu.metadata.log_manager import IndexLogManager
+from hyperspace_tpu.obs import trace as obs_trace
 from hyperspace_tpu.telemetry import HyperspaceEvent
 from hyperspace_tpu.testing import faults
 
@@ -155,6 +156,31 @@ class Action(abc.ABC):
 
     # -- driver (Action.run:84-105 + recovery/retry) ------------------------
     def run(self) -> None:
+        """Obs wrapper around the protocol: one ROOT span per lifecycle
+        action (child stage spans — scan/shuffle/sort/write/
+        sidecar_capture/log_commit — attach via the build breakdown
+        hooks), finished whatever the outcome, so every action is
+        explainable after the fact (docs/observability.md)."""
+        # configure, not just set_enabled: action-only processes (build
+        # workers with no frontend) must still honor the trace bounds
+        obs_trace.configure(self.session.conf)
+        index_name = getattr(self, "index_name", "") or getattr(
+            getattr(self, "index_config", None), "index_name", ""
+        )
+        root = obs_trace.root(
+            f"action.{type(self).__name__}", index=str(index_name)
+        )
+        with obs_trace.activate(root):
+            try:
+                self._run_protocol()
+                root.set("status", "ok")
+            except BaseException:
+                root.set("status", "failed")
+                raise
+            finally:
+                root.finish()
+
+    def _run_protocol(self) -> None:
         from hyperspace_tpu.metadata import recovery
 
         if _multiprocess():
@@ -206,17 +232,18 @@ class Action(abc.ABC):
         try:
             self.op()
             faults.crash("after_data_write", type(self).__name__)
-            final = self.log_entry().with_state(self.final_state)
-            final.id = self.base_id + 2
-            if not _publish_log(self.log_manager, self.base_id + 2, final):
-                # the end id exists already: a cancel()/recovery rolled
-                # our transient entry back under us — the data work must
-                # not be published over their write
-                raise ConcurrentWriteException(
-                    f"Concurrent write at log id {self.base_id + 2}"
-                )
-            faults.crash("after_end_log", type(self).__name__)
-            _publish_latest_stable(self.log_manager, self.base_id + 2)
+            with obs_trace.span("log_commit"):
+                final = self.log_entry().with_state(self.final_state)
+                final.id = self.base_id + 2
+                if not _publish_log(self.log_manager, self.base_id + 2, final):
+                    # the end id exists already: a cancel()/recovery
+                    # rolled our transient entry back under us — the
+                    # data work must not be published over their write
+                    raise ConcurrentWriteException(
+                        f"Concurrent write at log id {self.base_id + 2}"
+                    )
+                faults.crash("after_end_log", type(self).__name__)
+                _publish_latest_stable(self.log_manager, self.base_id + 2)
         except Exception as e:
             self._log_event(False, str(e))
             raise
@@ -308,13 +335,14 @@ class Action(abc.ABC):
             ).start()
         try:
             self.op()
-            final = self.log_entry().with_state(self.final_state)
-            final.id = self.base_id + 2
-            if not _publish_log(self.log_manager, self.base_id + 2, final):
-                raise ConcurrentWriteException(
-                    f"Concurrent write at log id {self.base_id + 2}"
-                )
-            _publish_latest_stable(self.log_manager, self.base_id + 2)
+            with obs_trace.span("log_commit"):
+                final = self.log_entry().with_state(self.final_state)
+                final.id = self.base_id + 2
+                if not _publish_log(self.log_manager, self.base_id + 2, final):
+                    raise ConcurrentWriteException(
+                        f"Concurrent write at log id {self.base_id + 2}"
+                    )
+                _publish_latest_stable(self.log_manager, self.base_id + 2)
         except Exception as e:
             self._log_event(False, str(e))
             raise

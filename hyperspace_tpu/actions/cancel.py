@@ -5,7 +5,8 @@ Reference: ``actions/CancelAction.scala`` (validates the index is stuck in
 a transient state, then appends a copy of the last stable entry so every
 operation sees the pre-failure state again; ``Hyperspace.scala:139-151``).
 Does not follow the begin/op/end protocol — it writes exactly one log
-entry — so it overrides ``run``.
+entry — so it overrides ``_run_protocol`` (keeping the base ``run``'s
+obs root span: a cancel is a lifecycle action and must trace like one).
 
 Since the recovery plane (PR 10) the actual rollback write lives in
 ``metadata/recovery.rollback`` and is shared with automatic
@@ -30,7 +31,7 @@ from hyperspace_tpu.telemetry import CancelActionEvent
 
 
 class CancelAction(Action):
-    transient_state = ""  # unused; run() is overridden
+    transient_state = ""  # unused; _run_protocol() is overridden
     final_state = ""
 
     def __init__(self, session, index_name: str, log_manager):
@@ -59,7 +60,7 @@ class CancelAction(Action):
     def log_entry(self) -> IndexLogEntry:  # pragma: no cover - not used
         raise NotImplementedError
 
-    def run(self) -> None:
+    def _run_protocol(self) -> None:
         from hyperspace_tpu.metadata import recovery
 
         self._resnapshot()

@@ -25,7 +25,11 @@ mechanically (see ``docs/static-analysis.md``):
   process-local loop bounds cannot make processes issue diverging
   collective programs, and ``--witness`` cross-checks the per-process
   runtime collective sequences recorded by
-  ``testing/collective_witness.py``.
+  ``testing/collective_witness.py``;
+* :mod:`obs` (HS9xx) — every span/metric instrumentation site is
+  declared in ``OBS_SITES`` (``obs/sites.py``) with a justification,
+  constant span/stage names stay inside the declared breakdown-key
+  vocabulary, and stale registry entries are flagged.
 
 Run it: ``python -m hyperspace_tpu.analysis [package_dir]`` — exits
 nonzero when any unsuppressed finding remains. Suppress a finding with
@@ -46,6 +50,7 @@ from hyperspace_tpu.analysis import (
     kernel_parity,
     locks,
     log_state,
+    obs,
     purity,
     shared_state,
     spmd,
@@ -70,6 +75,7 @@ CHECKERS = (
     shared_state,
     contracts,
     spmd,
+    obs,
 )
 
 #: rule id -> one-line description; HS001 is the analyzer's own

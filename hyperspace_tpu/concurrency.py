@@ -178,6 +178,26 @@ SHARED_STATE: Dict[str, Tuple[str, str, str]] = {
         "approximate-serve telemetry of the most recent estimate, "
         "published as one rebind of a freshly-built dict",
     ),
+    # -- observability plane (hyperspace_tpu/obs/) ---------------------------
+    "hyperspace_tpu.obs.trace._enabled": (
+        "",
+        "rebind-only",
+        "the process-global tracing switch: plain bool rebinds; a racy "
+        "read costs one span (recorded or skipped), never a torn value",
+    ),
+    "hyperspace_tpu.obs.trace._max_spans": (
+        "",
+        "rebind-only",
+        "per-trace span cap republished whole by configure(); a stale "
+        "read caps one trace at the previous bound",
+    ),
+    "hyperspace_tpu.obs.trace._finished": (
+        "hyperspace_tpu.obs.trace._rec_lock",
+        "guarded",
+        "the finished-trace ring: root finish/append, drain and reset "
+        "all hold the record lock (configure() swaps the deque under "
+        "it too)",
+    ),
     # -- recovery plane (metadata/recovery.py) -------------------------------
     "hyperspace_tpu.metadata.recovery._active_pins": (
         "hyperspace_tpu.metadata.recovery._pins_lock",

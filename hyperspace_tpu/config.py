@@ -344,6 +344,54 @@ class Config:
             ),
         )
 
+    # -- observability plane (hyperspace_tpu/obs/) ---------------------------
+    @property
+    def obs_enabled(self) -> bool:
+        """Structured tracing + durable query log (docs/observability.md);
+        off = the zero-cost no-op path, bit-identical serve behavior."""
+        return self.get_bool(C.OBS_ENABLED, C.OBS_ENABLED_DEFAULT)
+
+    @property
+    def obs_querylog_enabled(self) -> bool:
+        return self.get_bool(
+            C.OBS_QUERYLOG_ENABLED, C.OBS_QUERYLOG_ENABLED_DEFAULT
+        )
+
+    @property
+    def obs_querylog_max_bytes(self) -> int:
+        return max(
+            1,
+            self.get_int(
+                C.OBS_QUERYLOG_MAX_BYTES, C.OBS_QUERYLOG_MAX_BYTES_DEFAULT
+            ),
+        )
+
+    @property
+    def obs_querylog_max_files(self) -> int:
+        return max(
+            1,
+            self.get_int(
+                C.OBS_QUERYLOG_MAX_FILES, C.OBS_QUERYLOG_MAX_FILES_DEFAULT
+            ),
+        )
+
+    @property
+    def obs_trace_max_spans(self) -> int:
+        return max(
+            1,
+            self.get_int(C.OBS_TRACE_MAX_SPANS, C.OBS_TRACE_MAX_SPANS_DEFAULT),
+        )
+
+    @property
+    def obs_trace_retain(self) -> int:
+        return max(
+            1, self.get_int(C.OBS_TRACE_RETAIN, C.OBS_TRACE_RETAIN_DEFAULT)
+        )
+
+    @property
+    def obs_eventlog_path(self) -> str:
+        return self.get_str(C.OBS_EVENTLOG_PATH, C.OBS_EVENTLOG_PATH_DEFAULT)
+
     # -- replicated serve fleet (serve/fleet.py, serve/bus.py) ---------------
     @property
     def fleet_enabled(self) -> bool:

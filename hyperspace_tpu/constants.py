@@ -421,6 +421,56 @@ RECOVERY_RETRY_BACKOFF_MS_DEFAULT = 10
 # scans, like HYPERSPACE_LOG_DIR).
 HYPERSPACE_QUARANTINE_DIR = "_hyperspace_quarantine"
 
+# -- observability plane (hyperspace_tpu/obs/, docs/observability.md) --------
+# Master switch for structured tracing + the durable query log: every
+# query through the serve frontend and every lifecycle action gets ONE
+# root span with child stage spans mirroring the legacy breakdown keys,
+# and each served query appends one JSONL record to the _hyperspace_obs/
+# sidecar next to the lake. Off (the default) = the zero-cost path:
+# every obs call site degrades to a single module-bool check and the
+# serve/build behavior is bit-identical to the pre-obs tree.
+OBS_ENABLED = "hyperspace.obs.enabled"
+OBS_ENABLED_DEFAULT = False
+
+# Durable query log (obs/querylog.py): one JSONL record per served
+# query (fingerprint, predicate shape, stage timings, retry/degrade
+# events, trace id), written to per-process files under
+# <system.path>/_hyperspace_obs/ — the machine-readable workload
+# profile the advisor loop (ROADMAP item 5) mines. Requires obs.enabled.
+OBS_QUERYLOG_ENABLED = "hyperspace.obs.querylog.enabled"
+OBS_QUERYLOG_ENABLED_DEFAULT = True
+
+# Rotation bounds: the active per-process file rotates (fsync-before-
+# rename, crash-safe — see the mid_querylog_rotate crash point) once it
+# exceeds maxBytes, and at most maxFiles rotated segments are retained
+# per process (oldest pruned first). Readers union every segment of
+# every process, so rotation never loses in-flight records.
+OBS_QUERYLOG_MAX_BYTES = "hyperspace.obs.querylog.maxBytes"
+OBS_QUERYLOG_MAX_BYTES_DEFAULT = 4 << 20  # 4 MiB per segment
+OBS_QUERYLOG_MAX_FILES = "hyperspace.obs.querylog.maxFiles"
+OBS_QUERYLOG_MAX_FILES_DEFAULT = 8
+
+# Trace plane bounds (obs/trace.py): maxSpans caps the child spans
+# recorded per trace (excess children are dropped and counted in the
+# root's ``spans_dropped`` attr — a runaway per-bucket fan-out must not
+# hold the whole serve's span set in RAM); retain caps the in-memory
+# ring of finished traces kept for bench/test introspection.
+OBS_TRACE_MAX_SPANS = "hyperspace.obs.trace.maxSpans"
+OBS_TRACE_MAX_SPANS_DEFAULT = 512
+OBS_TRACE_RETAIN = "hyperspace.obs.trace.retain"
+OBS_TRACE_RETAIN_DEFAULT = 256
+
+# JSONL event sink path for telemetry events (obs/metrics.py JsonlSink
+# + telemetry.JsonlEventLogger): empty = next to the lake under
+# <system.path>/_hyperspace_obs/events.<pid>.jsonl when the Jsonl
+# logger is selected via hyperspace.eventLoggerClass.
+OBS_EVENTLOG_PATH = "hyperspace.obs.eventlog.path"
+OBS_EVENTLOG_PATH_DEFAULT = ""
+
+# Observability sidecar directory under the lake root (underscore-
+# prefixed: invisible to data scans, like the quarantine/pins dirs).
+HYPERSPACE_OBS_DIR = "_hyperspace_obs"
+
 # -- replicated serve fleet (serve/fleet.py, serve/bus.py) -------------------
 # Master switch for fleet mode: N ServeFrontend processes over ONE index
 # lake. Turns on (a) DURABLE query pins — each pinned snapshot is also

@@ -17,6 +17,7 @@ import numpy as np
 from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.io import parquet as pio
 from hyperspace_tpu.io.columnar import ColumnarBatch
+from hyperspace_tpu.obs import trace as _obs_trace
 from hyperspace_tpu.ops.filter import Unsupported, device_filter_mask
 from hyperspace_tpu.plan import expressions as E
 from hyperspace_tpu.plan.nodes import (
@@ -91,24 +92,29 @@ def _exec(plan: LogicalPlan, needed: Set[str], session) -> ColumnarBatch:
         # fully-covered row groups from the persisted partial-aggregate
         # sidecar WITHOUT reading them, scans only the boundary chunks,
         # and merges through the shared partials layer — bit-identical
-        # to the chains below
-        served = try_metadata_aggregate(plan, session)
-        if served is not None:
-            return served
-        # fused serve-pipeline compiler (docs/serve-compiler.md): a
-        # Filter(→Project)→Aggregate subtree over a pruned index scan
-        # runs as one fused native pass per row-group chunk — predicate,
-        # grouping and partial aggregates in a single sweep, partials
-        # merged at the edge; bit-identical to the chain below
-        fused = try_fused_aggregate(plan, session)
-        if fused is not None:
-            return fused
-        batch = _exec(plan.child, plan.input_columns, session)
-        from hyperspace_tpu.execution.aggregate_exec import execute_aggregate
+        # to the chains below. The agg/finalize stage span is the only
+        # serve-side visibility into these fused passes (OBS_SITES).
+        with _obs_trace.span("agg"):
+            served = try_metadata_aggregate(plan, session)
+            if served is not None:
+                return served
+            # fused serve-pipeline compiler (docs/serve-compiler.md): a
+            # Filter(→Project)→Aggregate subtree over a pruned index scan
+            # runs as one fused native pass per row-group chunk —
+            # predicate, grouping and partial aggregates in a single
+            # sweep, partials merged at the edge; bit-identical to the
+            # chain below
+            fused = try_fused_aggregate(plan, session)
+            if fused is not None:
+                return fused
+            batch = _exec(plan.child, plan.input_columns, session)
+            from hyperspace_tpu.execution.aggregate_exec import (
+                execute_aggregate,
+            )
 
-        return execute_aggregate(
-            batch, plan.group_by, plan.aggs, plan.child.schema()
-        )
+            return execute_aggregate(
+                batch, plan.group_by, plan.aggs, plan.child.schema()
+            )
     if isinstance(plan, Sort):
         from hyperspace_tpu.ops.sort import ordering_permutation
 
@@ -487,12 +493,15 @@ def _exec_join(plan: Join, needed: Set[str], session) -> ColumnarBatch:
             with ThreadPoolExecutor(
                 max_workers=2, thread_name_prefix="hs-joinside"
             ) as side_pool:
+                # trace.carry: contextvars do not cross pool threads —
+                # the side prepares' stage spans must still attach to
+                # the query's root span (identity when obs is off)
                 fl = side_pool.submit(
-                    _prepared_join_side,
+                    _obs_trace.carry(_prepared_join_side),
                     plan.left, l_needed, session, l_bucket_cols, l_keys,
                 )
                 fr = side_pool.submit(
-                    _prepared_join_side,
+                    _obs_trace.carry(_prepared_join_side),
                     plan.right, r_needed, session, r_bucket_cols, r_keys,
                 )
                 lp = fl.result()
@@ -1119,8 +1128,11 @@ def _bucket_stream(plan: LogicalPlan, needed: Set[str], session, bucket_cols):
             return run
 
         pool = scan_pool()
+        # scan-pool workers record the "scan" stage span; carry the
+        # query's trace context across the pool boundary (no-op obs-off)
+        read_traced = _obs_trace.carry(read_bucket)
         return [
-            (b, decode(pool.submit(read_bucket, list(groups[b]))))
+            (b, decode(pool.submit(read_traced, list(groups[b]))))
             for b in sorted(groups)
         ]
     if isinstance(plan, Project):
@@ -1151,8 +1163,8 @@ def _bucket_stream(plan: LogicalPlan, needed: Set[str], session, bucket_cols):
         # the serve cache on, repeat queries skip it entirely
         # (fingerprint-keyed ("delta", …) entry)
         delta_fut = scan_pool().submit(
-            _prepare_delta, plan.right, read_cols, session, bucket_cols,
-            spec[0],
+            _obs_trace.carry(_prepare_delta), plan.right, read_cols, session,
+            bucket_cols, spec[0],
         )
         left = _bucket_stream(
             plan.left, set(read_cols), session, bucket_cols

@@ -37,6 +37,8 @@ from typing import Callable, Dict, Iterable, List, Optional, Tuple
 import numpy as np
 
 from hyperspace_tpu.io.columnar import ColumnarBatch
+from hyperspace_tpu.obs import metrics as _obs_metrics
+from hyperspace_tpu.obs import trace as _obs_trace
 
 _SENTINEL_BASE = np.int64(-0x4000000000000000)
 
@@ -61,8 +63,22 @@ _PAR_MATCH_MIN_ROWS = 1 << 20
 # breakdown — meaningful for one join at a time (bench, diagnosis);
 # concurrent queries in a serve process interleave their timings here
 # (results are unaffected; only this attribution blurs).
+#
+# Since the obs plane (docs/observability.md) this dict is the backing
+# storage of a REGISTERED instrument: ``registry.stage_timer`` below
+# adopts the exact dict + lock (one storage — the registry's Prometheus
+# snapshot and every legacy reader see the same object; SHARED_STATE
+# unchanged), and ``_stage_add`` ALSO records a stage span on the
+# current trace, so a query's span timings and this breakdown are the
+# same measurement by construction.
 last_serve_breakdown: Dict[str, float] = {}
 _serve_bd_lock = _threading.Lock()
+_obs_metrics.registry.stage_timer(
+    "hs_serve_stage_seconds",
+    "serve stage busy seconds (breakdown view)",
+    data=last_serve_breakdown,
+    lock=_serve_bd_lock,
+)
 
 
 def serve_breakdown_reset() -> None:
@@ -76,6 +92,7 @@ def _stage_add(stage: str, t0: float) -> None:
         last_serve_breakdown[stage] = (
             last_serve_breakdown.get(stage, 0.0) + dt
         )
+    _obs_trace.stage(stage, t0)
 
 
 def _match_workers(n_tasks: int, total_rows: int) -> int:
@@ -425,7 +442,7 @@ def prepare_join_side_pipelined(
             with ThreadPoolExecutor(
                 max_workers=workers, thread_name_prefix="hs-shardprep"
             ) as pool:
-                shard_rows = list(pool.map(prep_shard, tasks))
+                shard_rows = list(pool.map(_obs_trace.carry(prep_shard), tasks))
         else:
             shard_rows = [prep_shard(g) for g in tasks]
         # union at the edge: back to ascending bucket order (the items
@@ -441,7 +458,7 @@ def prepare_join_side_pipelined(
             with ThreadPoolExecutor(
                 max_workers=workers, thread_name_prefix="hs-prep"
             ) as pool:
-                rows = list(pool.map(prep_one, items))
+                rows = list(pool.map(_obs_trace.carry(prep_one), items))
         else:
             rows = [prep_one(x) for x in items]
     t0 = _time.perf_counter()
@@ -524,7 +541,7 @@ def _host_match_native_presorted(
         from concurrent.futures import ThreadPoolExecutor
 
         with ThreadPoolExecutor(max_workers=workers) as pool:
-            grouped = list(pool.map(count_group, tasks))
+            grouped = list(pool.map(_obs_trace.carry(count_group), tasks))
     else:
         grouped = [count_group(g) for g in tasks]
     counts = [0] * B
@@ -562,7 +579,7 @@ def _host_match_native_presorted(
         from concurrent.futures import ThreadPoolExecutor
 
         with ThreadPoolExecutor(max_workers=workers) as pool:
-            oks = [ok for g in pool.map(emit_group, tasks) for ok in g]
+            oks = [ok for g in pool.map(_obs_trace.carry(emit_group), tasks) for ok in g]
     else:
         oks = [ok for g in tasks for ok in emit_group(g)]
     _stage_add("expand", t0)
@@ -661,7 +678,7 @@ def _host_match(
         from concurrent.futures import ThreadPoolExecutor
 
         with ThreadPoolExecutor(max_workers=workers) as pool:
-            grouped = list(pool.map(match_group, tasks))
+            grouped = list(pool.map(_obs_trace.carry(match_group), tasks))
     else:
         grouped = [match_group(g) for g in tasks]
     for pairs_g in grouped:

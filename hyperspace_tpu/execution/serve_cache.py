@@ -117,6 +117,13 @@ class ServeCache:
         self.evictions = 0
         self.evicted_bytes = 0
         self.insert_failures = 0
+        # live stats() view in the metrics registry (docs/observability.
+        # md; last-registered instance wins, the process-global
+        # telemetry doctrine) — weakly bound so the registry never
+        # keeps a replaced cache (and its gigabytes) alive
+        from hyperspace_tpu.obs import metrics as obs_metrics
+
+        obs_metrics.registry.register_weak_view("serve_cache", self)
 
     def get(self, key):
         with self._lock:
@@ -242,9 +249,15 @@ class ServeCache:
 
     def stats(self) -> dict:
         """One consistent snapshot of the governor's counters (taken
-        under the lock, so bytes/entries/high-water agree)."""
+        under the lock, so bytes/entries/high-water agree).
+        ``snapshot_at_ms`` stamps WHEN — merge several frontends'/
+        processes' snapshots with ``obs.merge_snapshots``, never by
+        hand."""
+        import time as _t
+
         with self._lock:
             return {
+                "snapshot_at_ms": int(_t.time() * 1000),
                 "resident_bytes": self._bytes,
                 "high_water_bytes": self.high_water_bytes,
                 "max_bytes": self.max_bytes,

@@ -52,6 +52,7 @@ import json
 import logging
 import os
 import threading
+import time as _time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -61,6 +62,7 @@ import pyarrow.compute as pa_compute
 import pyarrow.parquet as pq
 
 from hyperspace_tpu import constants as C
+from hyperspace_tpu.obs import trace as _obs_trace
 from hyperspace_tpu.testing import faults
 
 _log = logging.getLogger("hyperspace_tpu.aggindex")
@@ -364,6 +366,7 @@ def capture_index_dir(dir_path: str, index, conf=None) -> bool:
     )
     from hyperspace_tpu.io import parquet as pio
 
+    _t0 = _time.perf_counter()
     try:
         files = pio.list_format_files(dir_path, "parquet")
     except (OSError, KeyError):
@@ -413,6 +416,9 @@ def capture_index_dir(dir_path: str, index, conf=None) -> bool:
     from hyperspace_tpu.utils.files import fsync_dir
 
     fsync_dir(dir_path)
+    # build-tail I/O outside every breakdown stage — span it so action
+    # traces have no unexplained tail (OBS_SITES-registered)
+    _obs_trace.stage("sidecar_capture", _t0)
     return True
 
 

@@ -48,6 +48,8 @@ from hyperspace_tpu.constants import (
 from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.indexes.base import UpdateMode
 from hyperspace_tpu.io import parquet as pio
+from hyperspace_tpu.obs import metrics as _obs_metrics
+from hyperspace_tpu.obs import trace as _obs_trace
 from hyperspace_tpu.io.columnar import Column, ColumnarBatch
 from hyperspace_tpu.ops.hash import bucket_ids_np
 from hyperspace_tpu.ops.sort import sort_permutation
@@ -412,8 +414,21 @@ def prepare_covering_index(ctx, source_df, config, properties: Dict[str, str]):
 # time summed across shards (may exceed wall time — the excess over
 # ``tail_wall`` is the sharding win); ``tail_shards`` records how many
 # shard tails ran.
+#
+# Obs plane (docs/observability.md): this dict is the backing storage
+# of a REGISTERED instrument — ``registry.stage_timer`` below adopts
+# the exact dict + lock, so the registry's Prometheus snapshot and
+# every legacy reader share one storage (SHARED_STATE unchanged) — and
+# ``_stage_add`` also records a stage span on the current
+# lifecycle-action trace.
 last_build_breakdown: Dict[str, float] = {}
 _build_bd_lock = _threading.Lock()
+_obs_metrics.registry.stage_timer(
+    "hs_build_stage_seconds",
+    "build stage busy seconds (breakdown view)",
+    data=last_build_breakdown,
+    lock=_build_bd_lock,
+)
 
 # Non-timing telemetry of the most recent build: the exchange plane's
 # snapshot (``parallel/shuffle.last_shuffle_stats`` — chosen strategy,
@@ -428,6 +443,7 @@ def _stage_add(name: str, t0: float) -> None:
     dt = _time.perf_counter() - t0
     with _build_bd_lock:
         last_build_breakdown[name] = last_build_breakdown.get(name, 0.0) + dt
+    _obs_trace.stage(name, t0)
 
 
 def reset_build_breakdown() -> None:

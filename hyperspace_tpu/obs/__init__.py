@@ -1,0 +1,39 @@
+"""hyperspace_tpu.obs — the unified observability plane.
+
+Three legs (docs/observability.md):
+
+* :mod:`obs.trace` — structured tracing: one root span per frontend
+  query and per lifecycle action, child stage spans mirroring the
+  legacy breakdown keys, context propagated across every serve-path
+  thread pool and (via the fleet claim/spool plane and bus events)
+  across processes. Zero-cost no-op path when ``hyperspace.obs.enabled``
+  is off.
+* :mod:`obs.metrics` — the typed counter/gauge/stage-timer registry
+  that absorbed the scattered telemetry snapshots
+  (``last_serve_breakdown`` / ``last_build_breakdown`` are views over
+  registered instruments; frontend/cache ``stats()`` export as live
+  views), with a Prometheus text exporter and a JSONL sink.
+* :mod:`obs.querylog` — the durable per-query JSONL log next to the
+  lake (bounded, rotated, fleet-safe) — the workload profile the
+  advisor loop (ROADMAP item 5) mines.
+
+Every instrumentation site is declared in :mod:`obs.sites`
+(``OBS_SITES``); hslint HS9xx (``analysis/obs.py``) enforces it.
+"""
+
+from __future__ import annotations
+
+from hyperspace_tpu.obs import metrics, querylog, sites, trace
+from hyperspace_tpu.obs.metrics import merge_snapshots, registry
+from hyperspace_tpu.obs.querylog import QueryLog, read_records
+
+__all__ = [
+    "trace",
+    "metrics",
+    "querylog",
+    "sites",
+    "registry",
+    "merge_snapshots",
+    "QueryLog",
+    "read_records",
+]

@@ -1,0 +1,168 @@
+"""OBS_SITES — the registry of observability instrumentation sites.
+
+The SHARED_STATE / KERNEL_TWINS / COLLECTIVE_SITES doctrine applied to
+the observability plane: every call site that CREATES spans
+(``trace.root`` / ``trace.span`` / ``trace.stage``) or REGISTERS
+metrics (``registry.counter`` / ``gauge`` / ``labeled_counter`` /
+``stage_timer`` / ``register_view`` / ``register_weak_view``) declares
+itself HERE with a
+one-line justification — so "what is instrumented, and why?" is a
+mechanical question (``hslint`` HS9xx, ``analysis/obs.py``), not an
+archaeology project, and a hot loop cannot silently grow a span per
+row. Propagation shims (``trace.carry``/``activate``) and point events
+(``trace.event``) are deliberately exempt: they create no spans.
+
+Entry shape::
+
+    "<dotted path of the function, method, or module>": (
+        "<kind: span | metric | view>",
+        "<one-line justification — why this site is instrumented>",
+    )
+
+Paths name a module-level function
+(``hyperspace_tpu.execution.join_exec._stage_add``), a method
+(``hyperspace_tpu.serve.frontend.ServeFrontend.submit``), or a whole
+module (``hyperspace_tpu.execution.join_exec`` — module-level
+instrument registration). Calls in nested defs/lambdas attribute to
+their outermost enclosing def, like the collective registry.
+
+Stage-span VOCABULARY: HS902 rejects any constant stage/span name that
+is not listed below — stage spans exist to mirror the legacy breakdown
+keys, and a misspelled span name would silently fork the taxonomy the
+querylog, the bench gates and docs/observability.md all key on.
+
+Keep this module stdlib-only and import-cheap: the analyzer only ever
+parses it, and the obs plane imports it for the vocabulary.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+#: site kinds (HS903 rejects anything else)
+KINDS = ("span", "metric", "view")
+
+#: serve-side stage spans — the last_serve_breakdown keys plus the
+#: frontend's admission stages (docs/observability.md "Span taxonomy")
+SERVE_STAGES = (
+    "queue_wait",
+    "pin",
+    "rewrite",
+    "prune",
+    "scan",
+    "prepare",
+    "match",
+    "expand",
+    "verify",
+    "assemble",
+    "delta",
+    "agg",
+    "finalize",
+    "execute",
+)
+
+#: build/lifecycle stage spans — the last_build_breakdown keys plus the
+#: shuffle stage seconds and the metadata-plane seams
+BUILD_STAGES = (
+    "scan",
+    "hash_shuffle",
+    "pack",
+    "exchange",
+    "unpack",
+    "sort",
+    "write",
+    "sidecar_capture",
+    "log_commit",
+)
+
+#: root span names (constant ones; action roots are "action.<Class>")
+ROOT_NAMES = ("serve.query",)
+
+#: the full constant-name vocabulary HS902 checks against
+STAGE_NAMES = tuple(sorted(set(SERVE_STAGES) | set(BUILD_STAGES)))
+
+OBS_SITES: Dict[str, Tuple[str, str]] = {
+    # -- serve plane ---------------------------------------------------------
+    "hyperspace_tpu.serve.frontend.ServeFrontend.submit": (
+        "span",
+        "the query ROOT span starts at admission so queue-wait is "
+        "attributable; one root per admitted query is the bench gate",
+    ),
+    "hyperspace_tpu.serve.frontend.ServeFrontend._pin": (
+        "span",
+        "snapshot pinning is a metadata read with its own retry loop — "
+        "a slow pin must be distinguishable from a slow execute",
+    ),
+    "hyperspace_tpu.serve.frontend.ServeFrontend._run": (
+        "span",
+        "queue_wait closes when a worker picks the query up; the root "
+        "span finishes (and the querylog row lands) here",
+    ),
+    "hyperspace_tpu.serve.frontend.ServeFrontend._execute_pinned": (
+        "span",
+        "rewrite vs execute split: index selection time must never be "
+        "conflated with data-plane time",
+    ),
+    "hyperspace_tpu.serve.frontend.ServeFrontend.__init__": (
+        "view",
+        "the frontend's stats() counters export live through the "
+        "registry (one owner, one lock — no counter forking)",
+    ),
+    "hyperspace_tpu.execution.serve_cache.ServeCache.__init__": (
+        "view",
+        "the memory governor's stats() export live through the "
+        "registry, same single-owner discipline as the frontend",
+    ),
+    "hyperspace_tpu.execution.join_exec": (
+        "metric",
+        "last_serve_breakdown IS this stage_timer's backing dict — the "
+        "scattered serve snapshot absorbed as a registered instrument",
+    ),
+    "hyperspace_tpu.execution.join_exec._stage_add": (
+        "span",
+        "the ONE serve stage hook: the stage span and the breakdown "
+        "increment are the same measurement, so they cannot disagree",
+    ),
+    "hyperspace_tpu.execution.executor._exec": (
+        "span",
+        "the agg stage (metadata lowering + fused pass + interpreted "
+        "chain) is invisible to the join breakdown; its span closes the "
+        "serve taxonomy",
+    ),
+    # -- build / lifecycle plane ---------------------------------------------
+    "hyperspace_tpu.indexes.covering_build": (
+        "metric",
+        "last_build_breakdown IS this stage_timer's backing dict — the "
+        "build snapshot absorbed as a registered instrument",
+    ),
+    "hyperspace_tpu.indexes.covering_build._stage_add": (
+        "span",
+        "the ONE build stage hook, mirroring the serve-side discipline",
+    ),
+    "hyperspace_tpu.parallel.shuffle._publish_stats": (
+        "span",
+        "pack/exchange/unpack stage spans from the exchange's own "
+        "measured seconds — the fused-native-pass visibility Flare "
+        "argues for, applied to the shuffle",
+    ),
+    "hyperspace_tpu.indexes.aggindex.capture_index_dir": (
+        "span",
+        "sidecar capture is build-tail I/O outside every breakdown "
+        "stage; unexplained build tail time lands here",
+    ),
+    "hyperspace_tpu.actions.base.Action.run": (
+        "span",
+        "the lifecycle-action ROOT span — every action is explainable "
+        "after the fact, whatever the outcome",
+    ),
+    "hyperspace_tpu.actions.base.Action._run_protocol": (
+        "span",
+        "log_commit stage: metadata-plane publish time must be "
+        "separable from data-plane op() time",
+    ),
+    "hyperspace_tpu.actions.base.Action._run_coordinated": (
+        "span",
+        "the coordinator-side log_commit stage on multi-process jobs "
+        "(the same seam, behind the rendezvous protocol)",
+    ),
+}

@@ -240,6 +240,15 @@ def publish_action_event(session, index_name, index_path, action_name, entry):
         "index": index_name,
         "root": str(index_path).replace("\\", "/"),
     }
+    # cross-process trace propagation (docs/observability.md): the
+    # publishing action's trace id rides the event, so a peer's
+    # eviction/install is linkable to the lifecycle action that caused
+    # it (None with obs off — the field is simply absent)
+    from hyperspace_tpu.obs import trace as obs_trace
+
+    trace_id = obs_trace.current_trace_id()
+    if trace_id is not None:
+        event["trace_id"] = trace_id
     try:
         if (
             entry is not None

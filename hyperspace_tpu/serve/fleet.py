@@ -51,6 +51,7 @@ from typing import Optional, Tuple
 import pyarrow as pa
 
 from hyperspace_tpu.metadata import recovery
+from hyperspace_tpu.obs import trace as obs_trace
 from hyperspace_tpu.serve import bus as fleet_bus
 from hyperspace_tpu.serve.frontend import ServeFrontend, plan_fingerprint
 from hyperspace_tpu.utils import files as file_utils
@@ -185,7 +186,16 @@ class FleetFrontend(ServeFrontend):
                 st = os.stat(p)
             except OSError:
                 continue
-            if name.endswith(".arrow"):
+            if name.endswith(".arrow.trace"):
+                # trace-link sidecar: lives and dies with its result.
+                # Published BEFORE the .arrow, so an orphan is only
+                # reaped past the claim lease — a peer pruning in the
+                # sidecar->result publish window must not eat it
+                if name[: -len(".trace")] not in names and (
+                    (now - st.st_mtime) * 1000 > self._sf_claim_ms
+                ):
+                    file_utils.delete(p)
+            elif name.endswith(".arrow"):
                 entries.append((st.st_mtime, st.st_size, p))
             elif name.startswith(".tmp_spool_"):
                 # a kill -9 mid-publish leaks the temp; claim lease is a
@@ -203,6 +213,7 @@ class FleetFrontend(ServeFrontend):
             if total <= self._spool_max_bytes:
                 break
             file_utils.delete(p)
+            file_utils.delete(p + ".trace")
             total -= size
 
     def _try_claim(self, claim_path: str) -> str:
@@ -216,6 +227,11 @@ class FleetFrontend(ServeFrontend):
                 "nonce": nonce,
                 "pid": os.getpid(),
                 "expiresAtMs": int(time.time() * 1000) + self._sf_claim_ms,
+                # the claimant's trace id: waiting losers link their
+                # root span to the winner's trace (cross-process
+                # single-flight shows up as ONE logical execution in
+                # the obs plane; absent with obs off)
+                "traceId": obs_trace.current_trace_id(),
             }
         )
         try:
@@ -265,11 +281,20 @@ class FleetFrontend(ServeFrontend):
             if out is not None:
                 with self._lock:
                     self._spool_hits += 1
+                # link loser -> winner: the result's trace sidecar names
+                # the executing process's trace, so a cross-process
+                # dedup reads as ONE logical execution in the obs plane
+                obs_trace.event(
+                    "spool_hit",
+                    digest=digest,
+                    winner_trace_id=self._read_trace_sidecar(result_path),
+                )
                 return out
             verdict = self._try_claim(claim_path)
             if verdict == "won":
                 with self._lock:
                     self._claims_won += 1
+                obs_trace.event("singleflight_won", digest=digest)
                 try:
                     out = super()._execute_pinned(plan, pin)
                 except BaseException:
@@ -277,6 +302,9 @@ class FleetFrontend(ServeFrontend):
                     # not make every waiter ride out the claim lease
                     file_utils.delete(claim_path)
                     raise
+                # sidecar BEFORE the result: a loser polling every 2ms
+                # must never see the .arrow without its trace link
+                self._write_trace_sidecar(result_path)
                 self._write_spool(result_path, out)
                 file_utils.delete(claim_path)
                 return out
@@ -289,7 +317,40 @@ class FleetFrontend(ServeFrontend):
                 waiting = True
                 with self._lock:
                     self._claim_waits += 1
+                obs_trace.event(
+                    "singleflight_wait",
+                    digest=digest,
+                    winner_trace_id=self._read_claim_trace(claim_path),
+                )
             time.sleep(_SPOOL_POLL_S)
+
+    # -- trace linkage (docs/observability.md; best-effort everywhere) -------
+    def _write_trace_sidecar(self, result_path: str) -> None:
+        """Publish the winner's trace id next to its spooled result so
+        later spool hits can link to it (claim files vanish at commit)."""
+        trace_id = obs_trace.current_trace_id()
+        if trace_id is None:
+            return
+        try:
+            file_utils.atomic_overwrite(
+                result_path + ".trace", json.dumps({"traceId": trace_id})
+            )
+        except OSError:
+            pass
+
+    def _read_trace_sidecar(self, result_path: str) -> Optional[str]:
+        try:
+            with open(result_path + ".trace", "r", encoding="utf-8") as fh:
+                return json.load(fh).get("traceId")
+        except (OSError, ValueError):
+            return None
+
+    def _read_claim_trace(self, claim_path: str) -> Optional[str]:
+        try:
+            with open(claim_path, "r", encoding="utf-8") as fh:
+                return json.load(fh).get("traceId")
+        except (OSError, ValueError):
+            return None
 
     # -- introspection / lifecycle ------------------------------------------
     def stats(self) -> dict:

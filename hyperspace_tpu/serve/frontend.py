@@ -65,6 +65,9 @@ from hyperspace_tpu.exceptions import (
     ServeOverloadedError,
 )
 from hyperspace_tpu.metadata import recovery
+from hyperspace_tpu.obs import metrics as obs_metrics
+from hyperspace_tpu.obs import querylog as obs_querylog
+from hyperspace_tpu.obs import trace as obs_trace
 from hyperspace_tpu.plan.nodes import LogicalPlan
 from hyperspace_tpu.testing.faults import InjectedFault
 
@@ -184,6 +187,22 @@ class ServeFrontend:
         self._degraded_pins = 0
         self._failed = 0
         self._latencies: deque = deque(maxlen=4096)
+        # observability plane (docs/observability.md): adopt the
+        # session's hyperspace.obs.* settings (process-global,
+        # last-writer-wins — the telemetry doctrine), open the durable
+        # query log next to the lake, and export stats() as a live
+        # registry view. All three are no-ops/None with obs off.
+        self._obs_enabled = obs_trace.configure(session.conf)
+        self._querylog = None
+        if self._obs_enabled and session.conf.obs_querylog_enabled:
+            self._querylog = obs_querylog.QueryLog(
+                obs_querylog.obs_root(session.conf),
+                max_bytes=session.conf.obs_querylog_max_bytes,
+                max_files=session.conf.obs_querylog_max_files,
+            )
+        self._stats_view = obs_metrics.registry.register_weak_view(
+            "serve_frontend", self
+        )
 
     # -- snapshot pinning ---------------------------------------------------
     def _pin(self) -> Optional[Tuple]:
@@ -199,9 +218,10 @@ class ServeFrontend:
         backoff = session.conf.serve_retry_backoff_ms / 1000.0
         for attempt in range(attempts):
             try:
-                return tuple(
-                    session.index_manager.get_indexes([States.ACTIVE])
-                )
+                with obs_trace.span("pin"):
+                    return tuple(
+                        session.index_manager.get_indexes([States.ACTIVE])
+                    )
             # catch-all IS the contract: pin failure of any shape must
             # degrade to serving without indexes, never fail the query
             except Exception as exc:  # hslint: disable=HS402
@@ -251,7 +271,13 @@ class ServeFrontend:
         # pin read dropped the lock in between).
         with self._lock:
             self._check_admittable(cls)
-        pin = self._pin()
+        # the query ROOT span starts HERE so queue-wait is on the trace;
+        # a query that dedups onto an in-flight twin abandons it
+        # unfinished (one root span per EXECUTION is the contract —
+        # deduped submits share the winner's execution and its trace)
+        root = obs_trace.root("serve.query", slo_class=slo_class)
+        with obs_trace.activate(root):
+            pin = self._pin()
         # register the pinned snapshot's files with the recovery plane:
         # orphan GC (metadata/recovery.gc_orphans) never quarantines a
         # pinned file, so a version that goes unreferenced mid-query
@@ -264,6 +290,14 @@ class ServeFrontend:
             if pin is None
             else tuple((e.name, e.id) for e in pin),
         )
+        if root.span_id is not None:
+            import hashlib
+
+            root.set(
+                "fingerprint",
+                hashlib.sha256(repr(fp).encode("utf-8")).hexdigest()[:16],
+            )
+            root.set("predicate", obs_querylog.predicate_shape(plan))
         try:
             with self._lock:
                 existing = self._inflight.get(fp)
@@ -280,14 +314,14 @@ class ServeFrontend:
                     if cls is not None:
                         cls.running += 1
                     fut = self._pool.submit(
-                        self._run, plan, pin, pin_token, cls
+                        self._run, plan, pin, pin_token, cls, root
                     )
                 else:
                     # class concurrency cap reached: park the admission;
                     # a finishing class query dispatches it (the caller
                     # holds this outer future either way)
                     fut = Future()
-                    cls.pending.append((plan, pin, pin_token, fut))
+                    cls.pending.append((plan, pin, pin_token, fut, root))
                 self._inflight[fp] = fut
         except BaseException:
             recovery.release_pins(pin_token)
@@ -328,7 +362,7 @@ class ServeFrontend:
         (pin release is file I/O in fleet mode)."""
         cancelled: List[int] = []
         while cls.pending and cls.has_slot():
-            plan, pin, pin_token, outer = cls.pending.popleft()
+            plan, pin, pin_token, outer, root = cls.pending.popleft()
             # a parked outer future is a bare Future the caller may have
             # cancelled; claim it (RUNNING blocks further cancellation)
             # or drop the admission — a cancelled query must neither
@@ -338,7 +372,9 @@ class ServeFrontend:
                 self._queued -= 1
                 continue
             cls.running += 1
-            inner = self._pool.submit(self._run, plan, pin, pin_token, cls)
+            inner = self._pool.submit(
+                self._run, plan, pin, pin_token, cls, root
+            )
             _chain_future(inner, outer)
         return cancelled
 
@@ -358,8 +394,16 @@ class ServeFrontend:
         session = self._session
         optimized = plan
         if pin:
-            optimized = apply_hyperspace(session, plan, entries=list(pin))
-        return execute(optimized, session)
+            with obs_trace.span("rewrite"):
+                optimized = apply_hyperspace(session, plan, entries=list(pin))
+            cur = obs_trace.current()
+            if cur is not None:
+                cur.root.set(
+                    "indexes", obs_querylog.indexes_in_plan(optimized)
+                )
+                cur.root.set("rule", obs_querylog.rule_flavor(plan))
+        with obs_trace.span("execute"):
+            return execute(optimized, session)
 
     def _run(
         self,
@@ -367,9 +411,38 @@ class ServeFrontend:
         pin: Optional[Tuple],
         pin_token: int,
         cls: Optional[_SloClass] = None,
+        root=obs_trace.NOOP,
     ):
         with self._lock:
             self._queued -= 1
+        with obs_trace.activate(root):
+            if root.span_id is not None:
+                # admission -> worker pickup, on the root's own clock
+                obs_trace.stage("queue_wait", root._t0)
+            try:
+                out = self._run_attempts(plan, pin, pin_token, cls, root)
+                if root.span_id is not None:
+                    root.set("status", "ok")
+                    root.set("rows_returned", int(out.num_rows))
+                    self._querylog_append(root)
+                return out
+            except BaseException:
+                if root.span_id is not None:
+                    root.set("status", "failed")
+                    root.set("rows_returned", 0)
+                    self._querylog_append(root)
+                raise
+            finally:
+                root.finish()
+
+    def _run_attempts(
+        self,
+        plan: LogicalPlan,
+        pin: Optional[Tuple],
+        pin_token: int,
+        cls: Optional[_SloClass],
+        root,
+    ):
         session = self._session
         attempts = session.conf.serve_retry_max_attempts
         backoff = session.conf.serve_retry_backoff_ms / 1000.0
@@ -386,6 +459,9 @@ class ServeFrontend:
                         attempt += 1
                         with self._lock:
                             self._retries += 1
+                        root.add_event(
+                            "retry", attempt=attempt, error=str(exc)[:200]
+                        )
                         if backoff > 0:
                             time.sleep(backoff * (1 << (attempt - 2)))
                         # re-pin: a vacuum may have removed the pinned
@@ -402,6 +478,7 @@ class ServeFrontend:
                         # equivalence the differential suite guarantees)
                         with self._lock:
                             self._degraded += 1
+                        root.add_event("degrade", error=str(exc)[:200])
                         try:
                             out = self._execute_pinned(plan, ())
                         except Exception:
@@ -422,6 +499,33 @@ class ServeFrontend:
                 for token in dropped:
                     recovery.release_pins(token)
 
+    def _querylog_append(self, root) -> None:
+        """One record per executed query (docs/observability.md schema;
+        best-effort — an unwritable sidecar never fails the query)."""
+        if self._querylog is None:
+            return
+        self._querylog.append(
+            {
+                "ts_ms": root.start_ms,
+                "trace_id": root.trace_id,
+                "fingerprint": root.attrs.get("fingerprint", ""),
+                "predicate": root.attrs.get("predicate", ""),
+                "slo_class": root.attrs.get("slo_class"),
+                "indexes": root.attrs.get("indexes", []),
+                "rule": root.attrs.get("rule"),
+                "duration_s": time.perf_counter() - root._t0,
+                "stages": {
+                    k: round(v, 6) for k, v in root.stage_seconds().items()
+                },
+                "rows_returned": root.attrs.get("rows_returned", 0),
+                "events": [
+                    {k: v for k, v in ev.items()}
+                    for ev in root.events[-32:]
+                ],
+                "status": root.attrs.get("status", "ok"),
+            }
+        )
+
     def _record(self, t_start: float) -> None:
         dt = time.perf_counter() - t_start
         with self._lock:
@@ -431,10 +535,14 @@ class ServeFrontend:
     # -- introspection / lifecycle ------------------------------------------
     def stats(self) -> dict:
         """One consistent snapshot of the frontend counters, plus p50/p99
-        over the most recent completions (seconds)."""
+        over the most recent completions (seconds). ``snapshot_at_ms``
+        stamps WHEN — merge several frontends'/processes' snapshots
+        with ``obs.merge_snapshots`` (it sums counters, maxes
+        watermarks, drops percentiles), never by hand."""
         with self._lock:
             lat: List[float] = sorted(self._latencies)
             out = {
+                "snapshot_at_ms": int(time.time() * 1000),
                 "admitted": self._admitted,
                 "completed": self._completed,
                 "deduped": self._deduped,
@@ -480,13 +588,20 @@ class ServeFrontend:
         # their futures and release their pins OUTSIDE the lock (a
         # caller-cancelled future takes no exception — the cancel
         # already resolved it)
-        for _plan, _pin, pin_token, outer in parked:
+        for _plan, _pin, pin_token, outer, _root in parked:
             recovery.release_pins(pin_token)
             if outer.set_running_or_notify_cancel():
                 outer.set_exception(
                     HyperspaceException("ServeFrontend closed while queued")
                 )
         self._pool.shutdown(wait=wait)
+        if self._querylog is not None:
+            self._querylog.close()
+        # provider-matched: closing an OLD frontend must not tear down
+        # a newer live frontend's view (last-wins registration)
+        obs_metrics.registry.unregister_view(
+            "serve_frontend", self._stats_view
+        )
 
     def __enter__(self) -> "ServeFrontend":
         return self

@@ -80,6 +80,9 @@ crash point               armed site
                           latestStable pointer not yet republished
 ``mid_vacuum_delete``     ``actions/vacuum.py`` — between file deletes of
                           a vacuum / vacuum-outdated sweep
+``mid_querylog_rotate``   ``obs/querylog.py`` — active segment fsynced,
+                          sealed-segment rename not yet done (the query
+                          log's rotation crash window)
 ========================  ====================================================
 
 A crash point is ONE-SHOT in ``raise`` mode: it disarms itself when it
@@ -104,6 +107,7 @@ CRASH_POINTS = (
     "after_end_log",
     "mid_vacuum_delete",
     "mid_sidecar_publish",
+    "mid_querylog_rotate",
 )
 
 #: ``exit``-mode crash status — distinctive, so a subprocess test can tell

@@ -215,12 +215,29 @@ def worker_main(spec_path: str) -> int:
     wall = time.perf_counter() - t_start
     stats = fe.stats()
     fe.close()
+    obs_report = None
+    if session.conf.obs_enabled:
+        # the parent asserts cross-process trace linkage: this worker's
+        # root trace ids plus every winner id its spool hits linked to
+        from hyperspace_tpu.obs import trace as obs_trace
+
+        roots = obs_trace.finished("serve.query")
+        obs_report = {
+            "root_trace_ids": [r.trace_id for r in roots],
+            "spool_hit_links": [
+                e.get("winner_trace_id")
+                for r in roots
+                for e in r.events
+                if e.get("name") == "spool_hit"
+            ],
+        }
     lat_ms = sorted(x * 1000 for x in latencies)
     out = {
         "worker": spec["worker_id"],
         "pid": os.getpid(),
         "digests": digests,
         "served": served,
+        "obs": obs_report,
         "wall_s": wall,
         "p50_ms": lat_ms[len(lat_ms) // 2] if lat_ms else 0.0,
         "p99_ms": lat_ms[min(len(lat_ms) - 1, (len(lat_ms) * 99) // 100)]
@@ -361,15 +378,17 @@ def run_fleet(
             C.FLEET_PIN_LEASE_MS, WORKER_CONF[C.FLEET_PIN_LEASE_MS]
         )
     )
-    spool_hits = sum(
-        r["stats"].get("fleet", {}).get("spool_hits", 0) for r in results
+    # the ONE documented way to combine per-worker counter snapshots
+    # (obs.merge_snapshots: counters sum, watermarks max, percentiles
+    # drop) — this used to be three hand-rolled sum() folds
+    from hyperspace_tpu.obs import merge_snapshots
+
+    fleet_merged = merge_snapshots(
+        *(r["stats"].get("fleet", {}) for r in results)
     )
-    claims_won = sum(
-        r["stats"].get("fleet", {}).get("claims_won", 0) for r in results
-    )
-    bus_events = sum(
-        r["stats"].get("fleet", {}).get("bus_events", 0) for r in results
-    )
+    spool_hits = fleet_merged.get("spool_hits", 0)
+    claims_won = fleet_merged.get("claims_won", 0)
+    bus_events = fleet_merged.get("bus_events", 0)
     leaked = _converge_pins(index_root, lease_ms=lease_ms)
     return {
         "processes": n_procs,
@@ -390,6 +409,7 @@ def run_fleet(
         "claims_won": claims_won,
         "bus_events": bus_events,
         "leaked_pin_files": leaked,
+        "worker_obs": [r.get("obs") for r in results if r.get("obs")],
     }
 
 

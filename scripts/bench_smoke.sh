@@ -114,6 +114,19 @@ for clients in (1, 8, 64):
     assert r["p50_ms"] > 0 and r["p99_ms"] >= r["p50_ms"], r
     assert r["qps"] > 0, r
     assert r["cache_high_water_bytes"] <= r["cache_max_bytes"], r
+# the obs plane (docs/observability.md): the interleaved on/off A/B
+# rung must prove the structural contract — every query in the
+# concurrency rung produced exactly ONE root span and the querylog
+# gained exactly one schema-valid row per execution (the replay +
+# schema validation of every written row runs inside bench.py; here we
+# require the evidence it ran). Overhead on tiny smoke rows is noise —
+# the <=5% p50 bar is asserted by bench.py itself at the 4M rung.
+so = d["serve_obs"]
+assert so["executions"] > 0, so
+assert so["roots"] == so["executions"], so
+assert so["querylog_rows"] == so["executions"], so
+assert so["p50_on_ms"] > 0 and so["p50_off_ms"] > 0, so
+print("bench_smoke: obs plane ok:", so, file=sys.stderr)
 fi = d["fault_injection"]
 for point in ("parquet_read", "kernel_dispatch", "log_read", "cache_insert"):
     assert fi["fired"].get(point, 0) >= 1, (point, fi)

@@ -115,6 +115,87 @@ class TestSixtyFourClients:
             s1.conf.set(C.SERVE_CACHE_ENABLED, False)
             s1.clear_serve_cache()
 
+    def test_64_clients_obs_parent_child_integrity(self, s1, tmp_path):
+        """The ISSUE 15 rung: the full 64-client storm with tracing ON.
+        Every execution yields exactly ONE root span whose child spans
+        all chain to it (no cross-trace leakage through the shared scan
+        pool), the querylog row count equals executions, and results
+        stay bit-identical to serial."""
+        from hyperspace_tpu.obs import querylog, trace
+
+        d = tmp_path / "src"
+        d.mkdir()
+        for i in range(4):
+            _write_rows(str(d / f"p{i}.parquet"), 30_000, i)
+        hs = Hyperspace(s1)
+        df = s1.read.parquet(str(d))
+        hs.create_index(df, CoveringIndexConfig("i1", ["k"], ["q", "v"]))
+        s1.enable_hyperspace()
+        keys = list(range(0, 2_000, 37))
+        baseline = {
+            k: s1.execute(
+                df.filter(df["k"] == k).select("q", "v").logical_plan
+            )
+            for k in keys
+        }
+        s1.conf.set(C.OBS_ENABLED, True)
+        s1.conf.set(C.OBS_TRACE_RETAIN, 4096)
+        trace.reset()
+        fe = ServeFrontend(s1)
+        errors = []
+
+        def client(i):
+            try:
+                for j in range(8):
+                    k = keys[(i * 5 + j) % len(keys)]
+                    out = fe.serve(df.filter(df["k"] == k).select("q", "v"))
+                    assert out.equals(baseline[k]), k
+            except Exception as exc:
+                errors.append(exc)
+
+        threads = [
+            threading.Thread(target=client, args=(i,))
+            for i in range(CLIENTS)
+        ]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(300)
+            assert not errors, errors[:3]
+            stats = fe.stats()
+        finally:
+            fe.close()
+            trace.set_enabled(False)
+        assert stats["failed"] == 0
+        assert stats["completed"] + stats["deduped"] == CLIENTS * 8
+        roots = trace.finished("serve.query")
+        # one root per EXECUTION (dedup shares the winner's trace)
+        assert len(roots) == stats["completed"]
+        seen = set()
+        for root in roots:
+            assert root.trace_id not in seen
+            seen.add(root.trace_id)
+            by_id = {sp.span_id: sp for sp in root.spans}
+            by_id[root.span_id] = root
+            for sp in root.spans:
+                assert sp.trace_id == root.trace_id
+                if sp is root:
+                    continue
+                hops, cur = 0, sp
+                while cur is not root:
+                    assert cur.parent_id in by_id, (sp.name, root.trace_id)
+                    cur = by_id[cur.parent_id]
+                    hops += 1
+                    assert hops < 100
+            assert root.attrs["status"] == "ok"
+        # durable record per execution, every row schema-valid
+        records = querylog.read_records(querylog.obs_root(s1.conf))
+        assert len(records) == stats["completed"]
+        for r in records:
+            assert querylog.validate_record(r) is None, r
+        trace.reset()
+
     def test_64_clients_with_concurrent_refresh(self, s1, tmp_path):
         """Appends + incremental refreshes land WHILE 64 clients serve:
         every result is bit-identical to serial execution over the
