@@ -18,6 +18,11 @@ Prints exactly ONE JSON line on stdout; progress goes to stderr.
 
 Env knobs: HS_BENCH_ROWS (lineitem rows, default 4M), HS_BENCH_REPS
 (timing reps, default 5), HS_BENCH_BUCKETS (default 8).
+HS_RESIDENCY_WITNESS=<path> arms the runtime residency witness
+(testing/residency_witness.py) for the whole run: per-site peak bytes +
+RSS high-water land in the artifact AND in the headline JSON's
+"residency" block, and ``hslint --witness <path>`` gates the run
+against the ALLOC_SITES bound model (docs/static-analysis.md).
 """
 
 from __future__ import annotations
@@ -40,6 +45,15 @@ import pyarrow.parquet as pq
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+def rss_hwm() -> int:
+    """Process resident-set high-water mark in bytes (monotone over the
+    process lifetime — a per-rung reading is the peak *so far*, so
+    growth between rungs localizes which rung paid it)."""
+    from hyperspace_tpu.testing.residency_witness import rss_high_water_bytes
+
+    return rss_high_water_bytes()
 
 
 def timeit(fn, reps: int):
@@ -146,6 +160,18 @@ def main() -> None:
         from hyperspace_tpu import native
 
         native.load()
+
+        # HS_RESIDENCY_WITNESS=<path>: wrap every ALLOC_SITES-registered
+        # allocation site for the whole run and dump per-site peak bytes
+        # + RSS high-water into the artifact at the end; bench_smoke then
+        # gates `hslint --witness` on it (zero model gaps, zero
+        # bound-class violations). Armed before any workload so the
+        # witness sees the cold path too.
+        residency_art = os.environ.get("HS_RESIDENCY_WITNESS")
+        if residency_art:
+            from hyperspace_tpu.testing import residency_witness
+
+            residency_witness.install()
 
         # --- index build (cold = includes XLA compile; warm = steady state)
         cfg_l = CoveringIndexConfig(
@@ -1038,6 +1064,7 @@ def main() -> None:
                         "build_warm_s": round(rung_warm, 3),
                         "build_rows_per_sec": round(rung_rows / rung_warm),
                         "build_stage_seconds": rung_stages,
+                        "rss_high_water_bytes": rss_hwm(),
                     }
                 )
                 log(
@@ -1152,6 +1179,7 @@ def main() -> None:
                                 m_join["iqr"] * 1e3, 2
                             ),
                             "join_serve_stage_ms": m_join_stages,
+                            "rss_high_water_bytes": rss_hwm(),
                         }
                     )
                     log(
@@ -1182,6 +1210,21 @@ def main() -> None:
             hybrid_raw["p50"] / hybrid_idx["p50"],
         ]
         geomean = float(np.prod(speedups) ** (1.0 / len(speedups)))
+
+        # resident-set telemetry: always the process RSS high-water;
+        # per-site peak bytes too when the residency witness is armed
+        # (the artifact is also written here, for hslint --witness)
+        residency: dict = {"rss_high_water_bytes": rss_hwm()}
+        if residency_art:
+            from hyperspace_tpu.testing import residency_witness
+
+            wdoc = residency_witness.dump(residency_art)
+            residency["witness_artifact"] = residency_art
+            residency["witnessed_sites"] = len(wdoc["sites"])
+            residency["witness_peak_bytes_by_site"] = {
+                site: rec["peak_bytes"]
+                for site, rec in sorted(wdoc["sites"].items())
+            }
         print(
             json.dumps(
                 {
@@ -1342,6 +1385,7 @@ def main() -> None:
                     "ds_prune_files_total": ds_total,
                     "build_ladder": ladder,
                     "mesh_ladder": mesh_ladder,
+                    "residency": residency,
                 }
             )
         )

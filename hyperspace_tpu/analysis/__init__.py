@@ -1,6 +1,6 @@
 """hslint — repo-native static analysis for hyperspace_tpu.
 
-Eight checkers guard the correctness-critical seams nothing else checks
+Ten checkers guard the correctness-critical seams nothing else checks
 mechanically (see ``docs/static-analysis.md``):
 
 * :mod:`kernel_parity` (HS1xx) — every native C++ export has a
@@ -29,7 +29,12 @@ mechanically (see ``docs/static-analysis.md``):
 * :mod:`obs` (HS9xx) — every span/metric instrumentation site is
   declared in ``OBS_SITES`` (``obs/sites.py``) with a justification,
   constant span/stage names stay inside the declared breakdown-key
-  vocabulary, and stale registry entries are flagged.
+  vocabulary, and stale registry entries are flagged;
+* :mod:`residency` (HS10xx) — every row-proportional hot-path
+  materialization is declared in ``ALLOC_SITES`` (``memory.py``) with
+  a plane and a structurally-enforced bound class, and ``--witness``
+  cross-checks the per-site peak bytes recorded by
+  ``testing/residency_witness.py`` against the declared bounds.
 
 Run it: ``python -m hyperspace_tpu.analysis [package_dir]`` — exits
 nonzero when any unsuppressed finding remains. Suppress a finding with
@@ -52,6 +57,7 @@ from hyperspace_tpu.analysis import (
     log_state,
     obs,
     purity,
+    residency,
     shared_state,
     spmd,
 )
@@ -76,6 +82,7 @@ CHECKERS = (
     contracts,
     spmd,
     obs,
+    residency,
 )
 
 #: rule id -> one-line description; HS001 is the analyzer's own

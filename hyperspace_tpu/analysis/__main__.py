@@ -55,6 +55,10 @@ def main(argv=None) -> int:
         "model (repeatable). A lock-witness JSON "
         "(testing/lock_witness.py) checks the lock model: witnessed "
         "edges/locks absent from it are hard HS604 errors. A "
+        "residency-witness JSON (testing/residency_witness.py) checks "
+        "the allocation-bound model: a witnessed site absent from "
+        "ALLOC_SITES, or a per-site peak past its declared bound-class "
+        "ceiling, is a hard HS1004 error. A "
         "collective-witness prefix (testing/collective_witness.py; "
         "per-process <prefix>.p<i>.json files) merges the per-process "
         "collective sequences: any cross-process divergence or "
@@ -91,9 +95,10 @@ def main(argv=None) -> int:
         # registered site in its process, so a per-package comparison
         # would call each package's surface "unknown" to the other.
         # Artifact kind is sniffed from its content: a lock witness is a
-        # single JSON file with a "locks" map; a collective witness is a
-        # per-process <prefix>.p<i>.json family (or one such file).
-        from hyperspace_tpu.analysis import shared_state, spmd
+        # single JSON file with a "locks" map; a residency witness one
+        # with a "sites" map; a collective witness is a per-process
+        # <prefix>.p<i>.json family (or one such file).
+        from hyperspace_tpu.analysis import residency, shared_state, spmd
 
         try:
             doc = None
@@ -106,6 +111,11 @@ def main(argv=None) -> int:
                 lock_doc = shared_state.load_witness(witness, doc=doc)
                 gaps, warnings = shared_state.witness_cross_check(
                     projects, lock_doc, os.path.basename(witness)
+                )
+            elif isinstance(doc, dict) and "sites" in doc:
+                res_doc = residency.load_witness(witness, doc=doc)
+                gaps, warnings = residency.witness_cross_check(
+                    projects, res_doc, os.path.basename(witness)
                 )
             else:
                 docs = spmd.load_collective_witness(witness)

@@ -94,12 +94,26 @@ SHARED_STATE: Dict[str, Tuple[str, str, str]] = {
         "bounded LRU shared by every serve thread when serve-cache mode "
         "is off; get/put/evict/clear all run under the one lock",
     ),
+    "hyperspace_tpu.indexes.zonemaps._local_bytes": (
+        "hyperspace_tpu.indexes.zonemaps._local_lock",
+        "guarded",
+        "byte ledger of the zonemap module LRU (residency bound, "
+        "ALLOC_SITES doctrine); every read-modify-write runs under the "
+        "same lock as the cache it accounts for",
+    ),
     "hyperspace_tpu.indexes.aggindex._local_cache": (
         "hyperspace_tpu.indexes.aggindex._local_lock",
         "guarded",
         "bounded LRU of assembled aggregate-plane state shared by every "
         "serve thread when serve-cache mode is off; get/put/evict/clear "
         "all run under the one lock",
+    ),
+    "hyperspace_tpu.indexes.aggindex._local_bytes": (
+        "hyperspace_tpu.indexes.aggindex._local_lock",
+        "guarded",
+        "byte ledger of the aggregate-plane module LRU (residency "
+        "bound, ALLOC_SITES doctrine); every read-modify-write runs "
+        "under the same lock as the cache it accounts for",
     ),
     "hyperspace_tpu.execution.serve_cache.ServeCache._entries": (
         "self._lock",
@@ -253,6 +267,15 @@ SHARED_STATE: Dict[str, Tuple[str, str, str]] = {
         "guarded",
         "fired counters updated inside fire() and snapshotted by stats() "
         "under the one registry lock",
+    ),
+    # -- residency witness (testing/residency_witness.py) --------------------
+    "hyperspace_tpu.testing.residency_witness._sites": (
+        "hyperspace_tpu.testing.residency_witness._rec_lock",
+        "guarded",
+        "per-site peak-bytes/call counters updated by the recording "
+        "wrappers on every thread that calls a registered allocation "
+        "site; record/snapshot/reset all hold the recorder lock "
+        "(install/uninstall are single-threaded test setup by contract)",
     ),
     # -- collective witness (testing/collective_witness.py) ------------------
     "hyperspace_tpu.testing.collective_witness._records": (

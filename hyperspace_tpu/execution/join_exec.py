@@ -266,12 +266,16 @@ class PreparedJoinSide:
         n += self.sizes.nbytes + self.offs.nbytes
         if self.nulls is not None:
             n += self.nulls.nbytes
-        if not self.sorted_buckets:
+        if not self.sorted_buckets or self.nulls is not None:
             # pre-charge the sort-perm memo at its worst case — BOTH
             # sentinel parities (a cached side can serve as left in one
             # query and right in another, e.g. a self-join), 8 bytes/row
             # each: sizes are fixed at put() time, so growth must be
-            # charged up front or the byte cap stops bounding real memory
+            # charged up front or the byte cap stops bounding real memory.
+            # A sorted side with null keys still fills the memo: the
+            # sorted fast path requires nulls is None (see the serve
+            # merge's l_sorted/r_sorted predicates), so sentinel
+            # re-sorting falls back to bucket_sort_perm for it too.
             n += 2 * self.combined.nbytes
         return n
 

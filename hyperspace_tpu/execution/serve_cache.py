@@ -48,7 +48,9 @@ import threading
 from collections import OrderedDict
 from typing import Optional, Tuple
 
-from hyperspace_tpu.io.columnar import ColumnarBatch
+import numpy as np
+
+from hyperspace_tpu.io.columnar import Column, ColumnarBatch
 from hyperspace_tpu.testing import faults
 
 
@@ -66,16 +68,96 @@ def file_fingerprint(files) -> Optional[Tuple]:
     return tuple(out)
 
 
-def batch_nbytes(batch: ColumnarBatch) -> int:
-    """Approximate resident bytes of a batch (arrays + dictionaries)."""
-    total = 0
-    for c in batch.columns.values():
-        for a in (c.values, c.codes, c.validity):
+#: CPython small-object overhead charged per cached string (an empty
+#: ``str`` is ~49 bytes resident)
+_STR_OVERHEAD = 49
+
+
+def _owned_nbytes(a: np.ndarray) -> int:
+    """Resident bytes an ndarray actually pins. A zero-copy view (an
+    arrow-buffer-backed decode, a slice of a larger cached array) keeps
+    its WHOLE owner alive, so the owner's extent is what a byte governor
+    must charge — ``a.nbytes`` alone reports the slice extent and
+    undercounts exactly the pyarrow-backed entries. Walks the ``base``
+    chain to the owning ndarray, then charges the backing buffer
+    (``pyarrow.Buffer.size`` / ``memoryview.nbytes``) when it is larger
+    still."""
+    owner = a
+    while isinstance(owner.base, np.ndarray):
+        owner = owner.base
+    extent = max(int(a.nbytes), int(owner.nbytes))
+    base = owner.base
+    if base is None:
+        return extent
+    for attr in ("size", "nbytes"):  # pyarrow.Buffer / memoryview
+        n = getattr(base, attr, None)
+        if isinstance(n, int) and n > extent:
+            return n
+    return extent
+
+
+def estimate_nbytes(value, _depth: int = 0) -> int:
+    """Approximate resident bytes of an arbitrary cached value — THE
+    sizing primitive shared by the cache governor (``batch_nbytes``,
+    ``ScanCacheEntry.budget_nbytes``) and the residency witness
+    (``testing/residency_witness.py``), so the runtime accounting and
+    the HS10xx bound model measure with one ruler. View-aware: numpy
+    views charge their owner's full extent (``_owned_nbytes``), pyarrow
+    containers report their total buffer size, and composite values
+    (Column / ColumnarBatch / dict / sequence) recurse."""
+    if value is None:
+        return 0
+    if isinstance(value, np.ndarray):
+        return _owned_nbytes(value)
+    if isinstance(value, (bool, int, float)):
+        return 28
+    if isinstance(value, (str, bytes, bytearray)):
+        return len(value) + _STR_OVERHEAD
+    if isinstance(value, Column):
+        total = 0
+        for a in (value.values, value.codes, value.validity):
             if a is not None:
-                total += a.nbytes
-        if c.dictionary:
-            total += sum(len(s) + 49 for s in c.dictionary)
-    return total
+                total += _owned_nbytes(a)
+        if value.dictionary:
+            total += sum(len(s) + _STR_OVERHEAD for s in value.dictionary)
+        return total
+    if isinstance(value, ColumnarBatch):
+        return sum(
+            estimate_nbytes(c, _depth + 1) for c in value.columns.values()
+        )
+    gtbs = getattr(value, "get_total_buffer_size", None)
+    if callable(gtbs):  # pyarrow Table / RecordBatch / (Chunked)Array
+        return int(gtbs())
+    if type(value).__module__.partition(".")[0] == "pyarrow":
+        n = getattr(value, "size", None)  # pyarrow.Buffer
+        if isinstance(n, int):
+            return n
+    for attr in ("budget_nbytes", "nbytes"):
+        n = getattr(value, attr, None)
+        if isinstance(n, (int, float)):
+            return int(n)
+    if _depth >= 6:  # composite recursion guard; cached values are trees
+        return 0
+    if isinstance(value, dict):
+        return 64 + sum(
+            estimate_nbytes(k, _depth + 1) + estimate_nbytes(v, _depth + 1)
+            for k, v in value.items()
+        )
+    if isinstance(value, (list, tuple, set, frozenset)):
+        return 56 + sum(8 + estimate_nbytes(v, _depth + 1) for v in value)
+    try:
+        import sys
+
+        return int(sys.getsizeof(value))
+    except TypeError:
+        return 0
+
+
+def batch_nbytes(batch: ColumnarBatch) -> int:
+    """Approximate resident bytes of a batch (arrays + dictionaries).
+    Delegates to :func:`estimate_nbytes`, so view-backed columns charge
+    the buffers they pin, not just their slice extent."""
+    return estimate_nbytes(batch)
 
 
 class ServeCache:
@@ -351,10 +433,6 @@ class ScanCacheEntry:
         total = 0
         rows = self.num_rows
         for c in self.columns.values():
-            for a in (c.values, c.codes, c.validity):
-                if a is not None:
-                    total += a.nbytes
-            if c.dictionary:
-                total += sum(len(s) + 49 for s in c.dictionary)
+            total += estimate_nbytes(c)
             total += 8 * rows
         return total

@@ -671,10 +671,37 @@ def _decode_entry(entry: dict) -> Tuple[dict, int]:
 # Module-level bounded LRU for assembled agg data, so the metadata plane
 # works at full speed with serve-server mode OFF (the default). Keyed by
 # the file fingerprint, same staleness story as the ServeCache entries.
+# Bounded in BYTES as well as entries — AggData carries its own decoded
+# size (data.nbytes) and grouped partials over wide relations are not
+# small, so an entry cap alone is not a residency bound (ALLOC_SITES
+# doctrine, memory.py); _local_bytes is the ledger.
 # SHARED_STATE-registered ("guarded": every access under _local_lock).
 _local_lock = threading.Lock()
 _local_cache: "OrderedDict[tuple, AggData]" = OrderedDict()
+_local_bytes = 0
 _LOCAL_CACHE_ENTRIES = 32
+_LOCAL_CACHE_MAX_BYTES = 128 << 20
+
+
+def _local_put(key, data: "AggData") -> None:
+    """Insert into the module LRU, evicting oldest-first until both the
+    entry cap and the byte cap hold. Caller must NOT hold _local_lock."""
+    global _local_bytes
+    nbytes = int(data.nbytes)
+    if nbytes > _LOCAL_CACHE_MAX_BYTES:
+        return  # larger than the whole fallback cache: not cacheable
+    with _local_lock:
+        old = _local_cache.pop(key, None)
+        if old is not None:
+            _local_bytes -= int(old.nbytes)
+        while _local_cache and (
+            len(_local_cache) >= _LOCAL_CACHE_ENTRIES
+            or _local_bytes + nbytes > _LOCAL_CACHE_MAX_BYTES
+        ):
+            _, victim = _local_cache.popitem(last=False)
+            _local_bytes -= int(victim.nbytes)
+        _local_cache[key] = data
+        _local_bytes += nbytes
 
 
 def agg_data_for(
@@ -751,10 +778,7 @@ def agg_data_for(
     )
     if cache is not None:
         cache.put(key, data, data.nbytes)
-    with _local_lock:
-        _local_cache[key] = data
-        while len(_local_cache) > _LOCAL_CACHE_ENTRIES:
-            _local_cache.popitem(last=False)
+    _local_put(key, data)
     return data
 
 
@@ -762,8 +786,10 @@ def invalidate_local_cache() -> None:
     """Tests / operational tooling: drop the module-level assembled
     cache (sidecar/backfill memos are keyed by file identity and never
     serve stale)."""
+    global _local_bytes
     with _local_lock:
         _local_cache.clear()
+        _local_bytes = 0
 
 
 def invalidate_paths_under(root: str) -> int:
@@ -781,10 +807,12 @@ def invalidate_paths_under(root: str) -> int:
             return any(_mentions(x) for x in obj)
         return False
 
+    global _local_bytes
     with _local_lock:
         victims = [k for k in _local_cache if _mentions(k)]
         for k in victims:
-            del _local_cache[k]
+            victim = _local_cache.pop(k)
+            _local_bytes -= int(victim.nbytes)
         return len(victims)
 
 
@@ -876,10 +904,7 @@ def install_fanout_payload(payload: dict, cache=None) -> bool:
     key = ("aggstate", fp)
     if cache is not None:
         cache.put(key, data, data.nbytes)
-    with _local_lock:
-        _local_cache[key] = data
-        while len(_local_cache) > _LOCAL_CACHE_ENTRIES:
-            _local_cache.popitem(last=False)
+    _local_put(key, data)
     return True
 
 

@@ -678,84 +678,99 @@ def column_zones(cells, t: pa.DataType) -> "ColZones":
     return ColZones(domain, lo, hi, has, allnull)
 
 
+#: lazy-backfill chunking: per-file statistics (sidecar entries, decoded
+#: parquet footers) are folded into the per-column cell lists this many
+#: files at a time, and the per-directory sidecar dicts are dropped at
+#: every chunk boundary — so assembling a huge relation never holds the
+#: whole backfill's decoded statistics at once, only one chunk of them
+#: plus the O(row-group) cells (ALLOC_SITES: chunk-bounded). A directory
+#: spanning chunks re-reads its sidecar at most once per chunk.
+_ASSEMBLE_CHUNK_FILES = 64
+
+
 def assemble_zone_data(
     files: Tuple[str, ...], schema: Dict[str, pa.DataType]
 ) -> ZoneData:
     rg_file: List[int] = []
     rg_index: List[int] = []
     opaque = np.zeros(len(files), dtype=bool)
-    per_rg_stats: List[Optional[dict]] = []  # cols dict per rg (or None)
     zspans: list = []
     rg_spec: list = []
     zspecs: Dict[str, dict] = {}
     sidecar_n = footer_n = 0
     side_by_dir: Dict[str, Optional[dict]] = {}
-    for fi, path in enumerate(files):
-        d = os.path.dirname(path)
-        if d not in side_by_dir:
-            side_by_dir[d] = _sidecar_for_dir(d)
-        side = side_by_dir[d]
-        stats = _file_stats_from_sidecar(path, side)
-        if stats is not None:
-            sidecar_n += 1
-        else:
-            stats = footer_zones(path)
+    # per-column cell lists — the ONLY per-row-group state that survives
+    # a chunk; each cell is None / "allnull" / a (vmin, vmax) pair
+    cells_by_col: Dict[str, List] = {name: [] for name in schema}
+    col_seen: Dict[str, bool] = {name: False for name in schema}
+
+    def _fold(rows: Optional[int], rg_cols: Optional[dict]) -> None:
+        # derive one row group's cell per schema column; the full stats
+        # dict it came from dies with the chunk
+        for name in schema:
+            entry = rg_cols.get(name) if rg_cols is not None else None
+            if entry is None:
+                cells_by_col[name].append(None)
+                continue
+            col_seen[name] = True
+            vmin, vmax, nulls = entry
+            if vmin is None and vmax is None:
+                if nulls is not None and rows and nulls == rows:
+                    cells_by_col[name].append("allnull")
+                else:
+                    cells_by_col[name].append(None)
+                continue
+            cells_by_col[name].append((vmin, vmax))
+
+    for c0 in range(0, len(files), _ASSEMBLE_CHUNK_FILES):
+        side_by_dir.clear()  # chunk boundary: drop the decoded sidecars
+        for off, path in enumerate(files[c0 : c0 + _ASSEMBLE_CHUNK_FILES]):
+            fi = c0 + off
+            d = os.path.dirname(path)
+            if d not in side_by_dir:
+                side_by_dir[d] = _sidecar_for_dir(d)
+            side = side_by_dir[d]
+            stats = _file_stats_from_sidecar(path, side)
             if stats is not None:
-                footer_n += 1
-        if stats is None:
-            opaque[fi] = True
-            rg_file.append(fi)
-            rg_index.append(0)
-            per_rg_stats.append(None)
-            zspans.append(None)
-            rg_spec.append(None)
-            continue
-        spans = stats.get("rg_zspans")
-        spec = side.get("zorder") if side else None
-        if spec is not None and spans is not None:
-            zspecs.setdefault(d, spec)
-        n_rg = len(stats["rg_rows"])
-        for gi in range(n_rg):
-            rg_file.append(fi)
-            rg_index.append(gi)
-            per_rg_stats.append(
-                {
-                    "rows": stats["rg_rows"][gi],
-                    "cols": {
+                sidecar_n += 1
+            else:
+                stats = footer_zones(path)
+                if stats is not None:
+                    footer_n += 1
+            if stats is None:
+                opaque[fi] = True
+                rg_file.append(fi)
+                rg_index.append(0)
+                _fold(None, None)
+                zspans.append(None)
+                rg_spec.append(None)
+                continue
+            spans = stats.get("rg_zspans")
+            spec = side.get("zorder") if side else None
+            if spec is not None and spans is not None:
+                zspecs.setdefault(d, spec)
+            n_rg = len(stats["rg_rows"])
+            for gi in range(n_rg):
+                rg_file.append(fi)
+                rg_index.append(gi)
+                _fold(
+                    stats["rg_rows"][gi],
+                    {
                         name: entries[gi]
                         for name, entries in stats["cols"].items()
                         if gi < len(entries)
                     },
-                }
-            )
-            if spans is not None and spec is not None and gi < len(spans):
-                zspans.append(spans[gi])
-                rg_spec.append(d)
-            else:
-                zspans.append(None)
-                rg_spec.append(None)
-    n = len(rg_file)
+                )
+                if spans is not None and spec is not None and gi < len(spans):
+                    zspans.append(spans[gi])
+                    rg_spec.append(d)
+                else:
+                    zspans.append(None)
+                    rg_spec.append(None)
     cols: Dict[str, ColZones] = {}
     for name, t in schema.items():
-        cells: List = []
-        seen = False
-        for gi in range(n):
-            st = per_rg_stats[gi]
-            entry = st["cols"].get(name) if st is not None else None
-            if entry is None:
-                cells.append(None)
-                continue
-            seen = True
-            vmin, vmax, nulls = entry
-            if vmin is None and vmax is None:
-                if nulls is not None and nulls == st["rows"] and st["rows"] > 0:
-                    cells.append("allnull")
-                else:
-                    cells.append(None)
-                continue
-            cells.append((vmin, vmax))
-        if seen:
-            cols[name] = column_zones(cells, t)
+        if col_seen[name]:
+            cols[name] = column_zones(cells_by_col[name], t)
     return ZoneData(
         files=tuple(files),
         rg_file=np.asarray(rg_file, dtype=np.int64),
@@ -772,12 +787,38 @@ def assemble_zone_data(
 
 # Module-level bounded LRU for assembled zone data, so pruning works at
 # full speed with serve-server mode OFF (the default). Keyed by the file
-# fingerprint, same staleness story as the ServeCache entries.
+# fingerprint, same staleness story as the ServeCache entries. Bounded
+# in BYTES as well as entries (entries carry their zd.nbytes in the
+# value; _local_bytes is the ledger) — 64 wide-relation zone maps can be
+# gigabytes, and an entry cap alone is not a residency bound
+# (ALLOC_SITES doctrine, memory.py).
 # SHARED_STATE-registered ("guarded": every access under _local_lock);
 # the runtime lock witness wraps _local_lock during the stress suites.
 _local_lock = threading.Lock()
-_local_cache: "OrderedDict[tuple, ZoneData]" = OrderedDict()
+_local_cache: "OrderedDict[tuple, Tuple[ZoneData, int]]" = OrderedDict()
+_local_bytes = 0
 _LOCAL_CACHE_ENTRIES = 64
+_LOCAL_CACHE_MAX_BYTES = 256 << 20
+
+
+def _local_put(key, zd: ZoneData, nbytes: int) -> None:
+    """Insert into the module LRU, evicting oldest-first until both the
+    entry cap and the byte cap hold. Caller must NOT hold _local_lock."""
+    global _local_bytes
+    if nbytes > _LOCAL_CACHE_MAX_BYTES:
+        return  # larger than the whole fallback cache: not cacheable
+    with _local_lock:
+        old = _local_cache.pop(key, None)
+        if old is not None:
+            _local_bytes -= old[1]
+        while _local_cache and (
+            len(_local_cache) >= _LOCAL_CACHE_ENTRIES
+            or _local_bytes + nbytes > _LOCAL_CACHE_MAX_BYTES
+        ):
+            _, (_zd, freed) = _local_cache.popitem(last=False)
+            _local_bytes -= freed
+        _local_cache[key] = (zd, nbytes)
+        _local_bytes += nbytes
 
 
 def zone_data_for(rel, cache=None) -> Optional[Tuple[ZoneData, bool]]:
@@ -797,14 +838,12 @@ def zone_data_for(rel, cache=None) -> Optional[Tuple[ZoneData, bool]]:
         hit = _local_cache.get(key)
         if hit is not None:
             _local_cache.move_to_end(key)
-            return hit, True
+            return hit[0], True
     zd = assemble_zone_data(tuple(rel.files), rel.schema)
+    nbytes = zd.nbytes
     if cache is not None:
-        cache.put(key, zd, zd.nbytes)
-    with _local_lock:
-        _local_cache[key] = zd
-        while len(_local_cache) > _LOCAL_CACHE_ENTRIES:
-            _local_cache.popitem(last=False)
+        cache.put(key, zd, nbytes)
+    _local_put(key, zd, nbytes)
     return zd, False
 
 
@@ -812,8 +851,10 @@ def invalidate_local_cache() -> None:
     """Tests / operational tooling: drop the module-level assembled-map
     cache (the lru_cached footer/sidecar reads are keyed by file identity
     and never serve stale)."""
+    global _local_bytes
     with _local_lock:
         _local_cache.clear()
+        _local_bytes = 0
 
 
 def invalidate_paths_under(root: str) -> int:
@@ -831,10 +872,12 @@ def invalidate_paths_under(root: str) -> int:
             return any(_mentions(x) for x in obj)
         return False
 
+    global _local_bytes
     with _local_lock:
         victims = [k for k in _local_cache if _mentions(k)]
         for k in victims:
-            del _local_cache[k]
+            _zd, freed = _local_cache.pop(k)
+            _local_bytes -= freed
         return len(victims)
 
 

@@ -50,7 +50,16 @@ JAX_PLATFORMS=cpu python -m hyperspace_tpu.analysis hyperspace_tpu/ \
     --witness "$CW"
 echo "bench_smoke: collective-witness cross-check ok (zero divergence)" >&2
 rm -rf "$CW_DIR"
+# The bench run itself rides the RESIDENCY witness
+# (testing/residency_witness.py): every ALLOC_SITES-registered
+# allocation site records its per-call peak bytes, and hslint --witness
+# then cross-checks the artifact against the static bound model
+# (memory.py). A witnessed site the registry lacks, or a peak past its
+# declared bound-class ceiling, is a hard failure (HS1004 model gap).
+RESW="$(mktemp -t hs_residency_witness.XXXXXX.json)"
+rm -f "$RESW"
 OUT=$(JAX_PLATFORMS=cpu \
+HS_RESIDENCY_WITNESS="$RESW" \
 HS_BENCH_FORCE_CPU_DEVICES=8 \
 HS_BENCH_ROWS="$ROWS" \
 HS_BENCH_REPS="${HS_BENCH_REPS:-2}" \
@@ -62,6 +71,11 @@ HS_BENCH_FLEET_ITERS="${HS_BENCH_FLEET_ITERS:-4}" \
 HS_BENCH_FLEET_ROWS="${HS_BENCH_FLEET_ROWS:-20000}" \
 python bench.py)
 echo "$OUT"
+test -s "$RESW" || { echo "bench_smoke: residency witness artifact missing" >&2; exit 1; }
+JAX_PLATFORMS=cpu python -m hyperspace_tpu.analysis hyperspace_tpu/ \
+    --witness "$RESW"
+echo "bench_smoke: residency-witness cross-check ok (zero model gaps, bounds held)" >&2
+rm -f "$RESW"
 # the pruned filter path must actually have run: the z-order row's
 # zone-map telemetry is part of the bench JSON contract — and so are the
 # mesh ladder rows (a >1-device rung must have run the sharded tail and
@@ -193,4 +207,26 @@ for r in multi:
 print("bench_smoke: rangeprune telemetry ok:", zp, file=sys.stderr)
 print("bench_smoke: mesh ladder ok:", multi[-1]["build_stage_seconds"],
       multi[-1]["shuffle"], file=sys.stderr)
+# resident-set telemetry (memory.py ALLOC_SITES doctrine): every ladder
+# rung must carry the RSS high-water, and the witnessed run must have
+# recorded per-site peak bytes for at least the core serve sites (the
+# cross-check against the bound model already gated above)
+res = d["residency"]
+assert res["rss_high_water_bytes"] > 0, res
+assert res["witnessed_sites"] > 0, res
+peaks = res["witness_peak_bytes_by_site"]
+site = "hyperspace_tpu.io.parquet.read_table"
+assert site in peaks and peaks[site] > 0, (site, sorted(peaks))
+# the join rungs prepare sides via the pipelined streaming path on the
+# clean serve shape, the sequential twin otherwise — either witnesses
+prep_sites = [
+    "hyperspace_tpu.execution.join_exec.prepare_join_side",
+    "hyperspace_tpu.execution.join_exec.prepare_join_side_pipelined",
+]
+assert any(peaks.get(p, 0) > 0 for p in prep_sites), sorted(peaks)
+for r in d["build_ladder"] + d["mesh_ladder"]:
+    assert r["rss_high_water_bytes"] > 0, r
+print("bench_smoke: residency telemetry ok:",
+      {"rss_high_water_bytes": res["rss_high_water_bytes"],
+       "witnessed_sites": res["witnessed_sites"]}, file=sys.stderr)
 '

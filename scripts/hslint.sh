@@ -21,6 +21,16 @@
 #                                          #   scripts/dryrun_multihost.py):
 #                                          #   any cross-process sequence
 #                                          #   divergence is a hard HS804 error
+#   scripts/hslint.sh --witness res.json   # + cross-check a runtime RESIDENCY
+#                                          #   witness artifact (recorded by
+#                                          #   HS_RESIDENCY_WITNESS=res.json
+#                                          #   pytest/bench runs): a witnessed
+#                                          #   allocation site absent from
+#                                          #   ALLOC_SITES, or a per-site peak
+#                                          #   past its declared bound-class
+#                                          #   ceiling, is a hard HS1004 error
+#                                          #   (artifact kind is sniffed from
+#                                          #   content)
 #
 # Rule docs: docs/static-analysis.md
 set -euo pipefail

@@ -50,6 +50,29 @@ def _lock_witness_session():
         lock_witness.uninstall()
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _residency_witness_session():
+    """When ``HS_RESIDENCY_WITNESS=<path>`` is set, wrap every
+    ALLOC_SITES-registered allocation site for the whole test session
+    and dump the observed per-site peak bytes + call counts + process
+    RSS high-water into the artifact at exit (merging across suites).
+    ``hslint --witness <path>`` then cross-checks the runtime residency
+    against the static bound model — see scripts/bench_smoke.sh,
+    docs/static-analysis.md."""
+    path = os.environ.get("HS_RESIDENCY_WITNESS")
+    if not path:
+        yield
+        return
+    from hyperspace_tpu.testing import residency_witness
+
+    residency_witness.install()
+    try:
+        yield
+    finally:
+        residency_witness.dump(path)
+        residency_witness.uninstall()
+
+
 @pytest.fixture
 def tmp_index_root(tmp_path):
     """Per-test index system path (HyperspaceSuite's per-suite systemPath)."""

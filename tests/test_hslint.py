@@ -1924,6 +1924,371 @@ class TestObsSites:
 
 
 # ---------------------------------------------------------------------------
+# HS10xx: memory-residency contract (analysis/residency.py)
+# ---------------------------------------------------------------------------
+
+RES_REGISTRY = """
+    PLANES = ("build", "serve", "maintenance")
+    BOUND_CLASSES = (
+        "cache-governed",
+        "wave-budget",
+        "chunk-bounded",
+        "row-group-bounded",
+        "const-bounded",
+    )
+    ALLOC_SITES = {
+        "pkg.io.reader.load_table": (
+            "serve",
+            "cache-governed",
+            "materialized table is charged into the serve cache",
+        ),
+        "pkg.execution.scan.stream_chunks": (
+            "build",
+            "chunk-bounded",
+            "reads the file list in fixed-size chunks",
+        ),
+    }
+"""
+
+RES_IO = """
+    def read_table(paths):
+        return paths
+
+    def load_table(cache, paths):
+        t = read_table(paths)
+        cache.put("t", t)
+        return t
+"""
+
+RES_EXEC = """
+    from pkg.io.reader import read_table
+
+    def stream_chunks(files):
+        out = []
+        for start in range(0, len(files), 8):
+            out.append(read_table(files[start : start + 8]))
+        return out
+"""
+
+RES_FILES = {
+    "memory.py": RES_REGISTRY,
+    "io/reader.py": RES_IO,
+    "execution/scan.py": RES_EXEC,
+}
+
+
+def _res(findings):
+    return [f for f in findings if f.rule.startswith("HS10")]
+
+
+class TestResidency:
+    def test_clean_tree(self, tmp_path):
+        assert _res(_lint(tmp_path, RES_FILES)) == []
+
+    def test_no_registry_skips_checker(self, tmp_path):
+        # trees without an ALLOC_SITES registry have no residency
+        # contract to lint — even with unbounded hot-path reads
+        files = {
+            "io/reader.py": RES_IO,
+            "io/rogue.py": """
+                def hot_read(paths):
+                    return read_table(paths)
+            """,
+        }
+        assert _res(_lint(tmp_path, files)) == []
+
+    def test_undeclared_materialization_flagged(self, tmp_path):
+        files = dict(RES_FILES)
+        files["io/rogue.py"] = """
+            def hot_read(paths):
+                return read_table(paths)
+        """
+        findings = [
+            f for f in _lint(tmp_path, files) if f.rule == "HS1001"
+        ]
+        assert len(findings) == 1
+        assert "pkg.io.rogue.hot_read" in findings[0].message
+        assert "read_table" in findings[0].message
+
+    def test_arrow_materializer_on_tainted_value(self, tmp_path):
+        # the read AND the decode of its (relation-sized) result are
+        # both row-proportional materializations
+        files = dict(RES_FILES)
+        files["io/wide.py"] = """
+            def widen(files):
+                t = read_table(files)
+                return t.to_numpy()
+        """
+        findings = [
+            f for f in _lint(tmp_path, files) if f.rule == "HS1001"
+        ]
+        assert len(findings) == 2
+        msgs = "\n".join(f.message for f in findings)
+        assert "to_numpy" in msgs
+
+    def test_unbounded_accumulation_flagged(self, tmp_path):
+        # an accumulator appended to once per file of the relation is
+        # itself relation-proportional; concatenating it materializes
+        files = dict(RES_FILES)
+        files["execution/gather.py"] = """
+            import numpy as np
+
+            def gather(files):
+                parts = []
+                for f in files:
+                    parts.append(decode(f))
+                return np.concatenate(parts)
+        """
+        findings = [
+            f for f in _lint(tmp_path, files) if f.rule == "HS1001"
+        ]
+        assert len(findings) == 1
+        assert "concatenate" in findings[0].message
+
+    def test_slice_read_not_flagged(self, tmp_path):
+        # the row-group read path is bounded by construction
+        files = dict(RES_FILES)
+        files["io/rg.py"] = """
+            def per_group(paths, sel):
+                return read_table_row_groups(paths, sel)
+        """
+        assert [
+            f for f in _lint(tmp_path, files) if f.rule == "HS1001"
+        ] == []
+
+    def test_private_helper_outside_closure_not_flagged(self, tmp_path):
+        # HS1001 audits the reach closure from the public surface;
+        # an uncalled private helper is not on the hot path
+        files = dict(RES_FILES)
+        files["io/cold.py"] = """
+            def _cold(paths):
+                return read_table(paths)
+        """
+        assert [
+            f for f in _lint(tmp_path, files) if f.rule == "HS1001"
+        ] == []
+
+    def test_cold_dir_not_flagged(self, tmp_path):
+        # only execution/ indexes/ io/ serve/ are the hot path
+        files = dict(RES_FILES)
+        files["tooling.py"] = """
+            def offline_read(paths):
+                return read_table(paths)
+        """
+        assert [
+            f for f in _lint(tmp_path, files) if f.rule == "HS1001"
+        ] == []
+
+    def test_suppression_silences(self, tmp_path):
+        files = dict(RES_FILES)
+        files["io/rogue.py"] = """
+            def hot_read(paths):
+                # justified: caller holds one row group at a time
+                return read_table(paths)  # hslint: disable=HS1001
+        """
+        assert [
+            f for f in _lint(tmp_path, files) if f.rule == "HS1001"
+        ] == []
+
+    def test_cache_governed_without_put_flagged(self, tmp_path):
+        files = dict(RES_FILES)
+        files["io/reader.py"] = RES_IO.replace('cache.put("t", t)', "pass")
+        findings = [
+            f for f in _lint(tmp_path, files) if f.rule == "HS1002"
+        ]
+        assert len(findings) == 1
+        assert "pkg.io.reader.load_table" in findings[0].message
+        assert "never flows through" in findings[0].message
+
+    def test_chunk_bounded_without_loop_flagged(self, tmp_path):
+        files = dict(RES_FILES)
+        files["execution/scan.py"] = """
+            from pkg.io.reader import read_table
+
+            def stream_chunks(files):
+                return read_table(files)
+        """
+        findings = [
+            f for f in _lint(tmp_path, files) if f.rule == "HS1002"
+        ]
+        assert len(findings) == 1
+        assert "no chunk loop" in findings[0].message
+
+    def test_stale_entries_flagged(self, tmp_path):
+        stale_registry = """
+            ALLOC_SITES = {
+                "pkg.io.reader.load_table": (
+                    "serve", "cache-governed", "cached"
+                ),
+                "pkg.gone.fn": (
+                    "serve", "cache-governed", "site no longer exists"
+                ),
+                "pkg.io.reader.read_table": (
+                    "orbit", "cache-governed", "unknown plane"
+                ),
+                "pkg.io.reader.badbound": (
+                    "serve", "mystery", "unknown bound class"
+                ),
+                "pkg.io.reader.nowhy": ("serve", "const-bounded", ""),
+                "pkg.io.reader.quiet": (
+                    "serve", "const-bounded", "never allocates"
+                ),
+            }
+        """
+        files = {
+            "memory.py": stale_registry,
+            "io/reader.py": RES_IO + """
+    def badbound():
+        return 1
+
+    def nowhy():
+        return 2
+
+    def quiet():
+        return 3
+""",
+        }
+        findings = [
+            f for f in _lint(tmp_path, files) if f.rule == "HS1003"
+        ]
+        msgs = "\n".join(f.message for f in findings)
+        assert "pkg.gone.fn" in msgs and "does not resolve" in msgs
+        assert "unknown plane" in msgs
+        assert "unknown bound" in msgs
+        assert "no justification" in msgs
+        assert "neither allocates" in msgs
+        assert len(findings) == 5
+
+    def test_witness_cross_check_unit(self, tmp_path):
+        """Model gaps and ceiling breaches from a crafted artifact
+        against a fixture registry — the `hslint --witness` core."""
+        from hyperspace_tpu.analysis import residency
+        from hyperspace_tpu.analysis.core import Project
+
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        _write_tree(pkg, RES_FILES)
+        project = Project(str(pkg))
+        doc = {
+            "version": 1,
+            "sites": {
+                "pkg.io.reader.load_table": {
+                    "peak_bytes": 150,
+                    "calls": 2,
+                },
+                "ghost.mod.fn": {"peak_bytes": 7, "calls": 1},
+            },
+            "budgets": {"cache-governed": 100, "chunk-bounded": 50},
+        }
+        gaps, warnings = residency.witness_cross_check(
+            [project], doc, "res.json"
+        )
+        assert sorted(f.rule for f in gaps) == ["HS1004", "HS1004"]
+        msgs = "\n".join(f.message for f in gaps)
+        assert "ghost.mod.fn" in msgs and "absent from ALLOC_SITES" in msgs
+        assert "ceiling" in msgs and "150" in msgs
+        # the never-driven registered site warns, never errors
+        assert any("stream_chunks" in w for w in warnings)
+        # malformed artifacts raise (the CLI maps this to exit 2)
+        with pytest.raises(ValueError):
+            residency.load_witness("x.json", doc={"sites": {"a": 3}})
+        with pytest.raises(ValueError):
+            residency.load_witness("x.json", doc={"version": 1})
+
+    def test_witness_round_trip(self, tmp_path):
+        """install → drive a registered site → dump → merge → static
+        cross-check: the full runtime loop over the REAL registry."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from hyperspace_tpu.analysis import residency
+        from hyperspace_tpu.analysis.core import Project
+        from hyperspace_tpu.testing import residency_witness
+
+        f = tmp_path / "t.parquet"
+        pq.write_table(
+            pa.table({"a": pa.array(range(1000), type=pa.int64())}),
+            str(f),
+        )
+        art = str(tmp_path / "res.json")
+        site = "hyperspace_tpu.io.parquet.read_table"
+        residency_witness.reset()
+        wrapped = residency_witness.install()
+        try:
+            from hyperspace_tpu.io import parquet as hp
+
+            hp.read_table([str(f)])
+            residency_witness.dump(art)
+            residency_witness.reset()
+            hp.read_table([str(f)])
+            doc = residency_witness.dump(art)  # merges with the first
+        finally:
+            residency_witness.uninstall()
+            residency_witness.reset()
+        # every registered site resolves to something wrappable
+        assert all(wrapped.values()), [
+            s for s, ok in wrapped.items() if not ok
+        ]
+        rec = doc["sites"][site]
+        assert rec["calls"] == 2  # merge sums calls across dumps
+        assert rec["peak_bytes"] >= 1000 * 8  # the int64 column
+        assert doc["rss_high_water"] > 0
+        # budgets are stamped from memory.BOUND_CLASS_CEILINGS
+        from hyperspace_tpu import memory
+
+        assert doc["budgets"] == memory.BOUND_CLASS_CEILINGS
+        # the artifact round-trips through the static cross-check clean
+        loaded = residency.load_witness(art)
+        project = Project(PKG_DIR, tests_dir=TESTS_DIR)
+        gaps, warnings = residency.witness_cross_check(
+            [project], loaded, "res.json"
+        )
+        assert gaps == []
+        assert warnings  # sites this run never drove warn as stale
+
+    def test_real_registry_resolves_and_engages(self):
+        """Engagement guard over the real tree: the registry parses,
+        every entry resolves to an indexed function/method with a live
+        allocation, and the declared taxonomy covers all five bound
+        classes the witness gates on."""
+        from hyperspace_tpu import memory
+        from hyperspace_tpu.analysis import residency
+        from hyperspace_tpu.analysis.core import Project
+
+        project = Project(PKG_DIR, tests_dir=TESTS_DIR)
+        entries, rel = residency.parse_sites(project)
+        assert rel == "memory.py"
+        assert len(entries) >= 20
+        # the parsed (never-imported) registry matches the runtime one
+        assert {e.path for e in entries} == set(memory.ALLOC_SITES)
+        for e in entries:
+            assert e.plane in residency.PLANES, e.path
+            assert e.bound in residency.BOUND_CLASSES, e.path
+            assert e.why.strip(), e.path
+        index = residency.build_index(project)
+        by_site = {fn.site for fn in index.values()}
+        for e in entries:
+            assert e.path in by_site, e.path
+        # declared sites are actually on the audited hot path
+        closure_sites = {
+            index[k].site for k in residency.reach_closure(index)
+        }
+        assert "hyperspace_tpu.io.parquet.read_table" in closure_sites
+        assert (
+            "hyperspace_tpu.execution.join_exec.prepare_join_side"
+            in closure_sites
+        )
+        # every bound class is exercised by some declared site, and
+        # every class has a witness ceiling
+        assert {e.bound for e in entries} == set(residency.BOUND_CLASSES)
+        assert set(memory.BOUND_CLASS_CEILINGS) == set(
+            residency.BOUND_CLASSES
+        )
+        assert residency.PLANES == memory.PLANES
+        assert residency.BOUND_CLASSES == memory.BOUND_CLASSES
+
+
+# ---------------------------------------------------------------------------
 # Golden: ruleset + finding schema stability
 # ---------------------------------------------------------------------------
 
@@ -1931,6 +2296,10 @@ class TestObsSites:
 class TestGolden:
     EXPECTED_RULES = [
         "HS001",
+        "HS1001",
+        "HS1002",
+        "HS1003",
+        "HS1004",
         "HS101",
         "HS102",
         "HS103",
@@ -2082,17 +2451,73 @@ class TestCli:
         assert proc.returncode == 1
         assert "HS804" in proc.stdout
 
+    def test_residency_witness_clean_exits_zero(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        _write_tree(pkg, RES_FILES)
+        wit = tmp_path / "res.json"
+        wit.write_text(
+            '{"version": 1, "sites": {"pkg.io.reader.load_table": '
+            '{"peak_bytes": 10, "calls": 1}}, '
+            '"budgets": {"cache-governed": 100}}'
+        )
+        proc = self._run(str(pkg), "--witness", str(wit))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        # the never-driven registered site warns on stderr
+        assert "never witnessed" in proc.stderr
+
+    def test_residency_witness_model_gap_exits_one(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        _write_tree(pkg, {"m.py": "def f():\n    return 1\n"})
+        wit = tmp_path / "res.json"
+        wit.write_text(
+            '{"version": 1, "sites": {"ghost.mod.fn": '
+            '{"peak_bytes": 7, "calls": 1}}}'
+        )
+        proc = self._run(str(pkg), "--witness", str(wit))
+        assert proc.returncode == 1
+        assert "HS1004" in proc.stdout
+
+    def test_residency_witness_budget_breach_exits_one(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        _write_tree(pkg, RES_FILES)
+        wit = tmp_path / "res.json"
+        wit.write_text(
+            '{"version": 1, "sites": {"pkg.io.reader.load_table": '
+            '{"peak_bytes": 101, "calls": 1}}, '
+            '"budgets": {"cache-governed": 100}}'
+        )
+        proc = self._run(str(pkg), "--witness", str(wit))
+        assert proc.returncode == 1
+        assert "HS1004" in proc.stdout
+        assert "ceiling" in proc.stdout
+
+    def test_residency_witness_malformed_exits_two(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        _write_tree(pkg, {"m.py": "def f():\n    return 1\n"})
+        wit = tmp_path / "res.json"
+        wit.write_text('{"version": 1, "sites": {"x": 3}}')
+        proc = self._run(str(pkg), "--witness", str(wit))
+        assert proc.returncode == 2
+
     def test_both_witness_kinds_in_one_run(self, tmp_path):
-        # --witness is repeatable: one lock artifact + one collective
-        # family, each dispatched by content
+        # --witness is repeatable: one lock artifact + one residency
+        # artifact + one collective family, each dispatched by content
         pkg = tmp_path / "pkg"
         _write_tree(pkg, {"collectives.py": SPMD_REGISTRY, "comm.py": SPMD_COMM})
         lock_wit = tmp_path / "locks.json"
         lock_wit.write_text('{"version": 1, "locks": {}, "edges": []}')
+        res_wit = tmp_path / "res.json"
+        res_wit.write_text('{"version": 1, "sites": {}}')
         seq = [_rec("pkg.comm.exchange")]
         _cw_artifact(tmp_path, 0, seq)
         prefix = _cw_artifact(tmp_path, 1, seq)
         proc = self._run(
-            str(pkg), "--witness", str(lock_wit), "--witness", prefix
+            str(pkg),
+            "--witness",
+            str(lock_wit),
+            "--witness",
+            str(res_wit),
+            "--witness",
+            prefix,
         )
         assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -20,11 +20,12 @@ from hyperspace_tpu.execution.serve_cache import (
     ScanCacheEntry,
     ServeCache,
     batch_nbytes,
+    estimate_nbytes,
     file_fingerprint,
 )
 from hyperspace_tpu.hyperspace import Hyperspace
 from hyperspace_tpu.indexes.covering import CoveringIndexConfig
-from hyperspace_tpu.io.columnar import ColumnarBatch
+from hyperspace_tpu.io.columnar import Column, ColumnarBatch
 
 
 def sorted_table(t: pa.Table) -> pa.Table:
@@ -69,6 +70,65 @@ class TestServeCacheUnit:
         c.clear()
         assert c.get("a") is None
         assert c.resident_bytes == 0
+
+
+class TestEstimateNbytes:
+    """estimate_nbytes — the one sizing ruler shared by the cache
+    governor (batch_nbytes, ScanCacheEntry.budget_nbytes) and the
+    residency witness (testing/residency_witness.py, hslint HS1004).
+    The doctrine under test: a value is charged for every byte it PINS,
+    not just the extent of the slice it exposes."""
+
+    def test_numpy_view_charges_owner(self):
+        a = np.arange(1000, dtype=np.int64)
+        assert estimate_nbytes(a) == 8000
+        # a 10-element view keeps all 8000 bytes alive
+        assert estimate_nbytes(a[:10]) == 8000
+        # a view of a view still finds the owner
+        assert estimate_nbytes(a[:100][5:10]) == 8000
+
+    def test_owning_copy_charges_its_own_extent(self):
+        a = np.arange(1000, dtype=np.int64)
+        assert estimate_nbytes(a[:10].copy()) == 80
+
+    def test_arrow_backed_column_charges_buffer(self):
+        # zero-copy decode: the Column's numpy values array is a view
+        # over the arrow buffer — the pre-fix accounting charged only
+        # the slice extent and undercounted exactly these entries
+        t = pa.table({"k": pa.array(range(100_000), type=pa.int64())})
+        col = ColumnarBatch.from_arrow(t).column("k")
+        assert estimate_nbytes(col) >= 100_000 * 8
+        assert batch_nbytes(ColumnarBatch.from_arrow(t)) >= 100_000 * 8
+
+    def test_string_column_charges_dictionary(self):
+        t = pa.table({"s": pa.array(["aa", "bb", "aa", "cc"])})
+        col = ColumnarBatch.from_arrow(t).column("s")
+        # int32 codes + the three dictionary strings with per-object
+        # overhead (an empty str is ~49 resident bytes)
+        assert estimate_nbytes(col) >= 4 * 4 + 3 * (2 + 49)
+
+    def test_pyarrow_table_uses_buffer_size(self):
+        t = pa.table({"k": pa.array(range(100), type=pa.int64())})
+        assert estimate_nbytes(t) == t.get_total_buffer_size()
+
+    def test_entry_budget_charges_pinned_bytes(self):
+        # a ScanCacheEntry holding a view over a large decoded array is
+        # charged what it pins (the whole owner), not the subset extent
+        # — the governor can no longer undercount
+        n = 10_000
+        big = np.arange(n, dtype=np.int64)
+        sub = Column("numeric", pa.int64(), values=big[:5])
+        entry = ScanCacheEntry([(0, 5)]).with_new_columns({"k": sub})
+        assert entry.budget_nbytes >= n * 8
+
+    def test_cache_accounting_matches_estimate(self):
+        c = ServeCache(max_bytes=1 << 30)
+        t = pa.table({"k": pa.array(range(1000), type=pa.int64())})
+        batch = ColumnarBatch.from_arrow(t)
+        a = np.arange(1000, dtype=np.float64)
+        c.put("b", batch, estimate_nbytes(batch))
+        c.put("a", a[:10], estimate_nbytes(a[:10]))
+        assert c.resident_bytes == estimate_nbytes(batch) + a.nbytes
 
 
 class TestFingerprint:
