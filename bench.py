@@ -18,6 +18,11 @@ Prints exactly ONE JSON line on stdout; progress goes to stderr.
 
 Env knobs: HS_BENCH_ROWS (lineitem rows, default 4M), HS_BENCH_REPS
 (timing reps, default 5), HS_BENCH_BUCKETS (default 8).
+HS_BENCH_STREAM_LADDER (out-of-core join rung rows, default
+64M,256M; append 1000000000 for the opt-in 1B rung),
+HS_BENCH_STREAM_MAX_BYTES (wave budget override),
+HS_BENCH_STREAM_BASELINE_MAX (largest rung that also times the
+materializing stream-off baseline, default 64M).
 HS_RESIDENCY_WITNESS=<path> arms the runtime residency witness
 (testing/residency_witness.py) for the whole run: per-site peak bytes +
 RSS high-water land in the artifact AND in the headline JSON's
@@ -1194,6 +1199,179 @@ def main() -> None:
             finally:
                 shutil.rmtree(rung_dir, ignore_errors=True)
 
+        # --- out-of-core streaming ladder (docs/out-of-core.md): the
+        # join served in budget-packed waves with the spill tier and
+        # mmap reads on. The 256M rung is the tentpole claim: it must
+        # COMPLETE with peak residency O(wave), where the materializing
+        # path holds both decoded sides at once. The stream-off baseline
+        # runs only up to HS_BENCH_STREAM_BASELINE_MAX rows (default
+        # 64M) — above that the materializing peak is exactly what the
+        # flag exists to avoid. 1B rows is opt-in:
+        # HS_BENCH_STREAM_LADDER=64000000,256000000,1000000000.
+        from hyperspace_tpu.execution import executor as ex_mod
+
+        stream_env = os.environ.get(
+            "HS_BENCH_STREAM_LADDER", "64000000,256000000"
+        )
+        baseline_max = int(
+            os.environ.get("HS_BENCH_STREAM_BASELINE_MAX", 64_000_000)
+        )
+        stream_ladder = []
+        for rung_rows in [
+            int(x) for x in stream_env.split(",") if x.strip()
+        ]:
+            rung_dir = os.path.join(tmp, f"stream_{rung_rows}")
+            try:
+                sldir, sodir = gen_data(
+                    rung_dir,
+                    rung_rows,
+                    max(rung_rows // 8, 1),
+                    n_files=max(8, rung_rows // 8_000_000),
+                )
+                # buckets scale with rows (~4M rows/bucket) so a wave
+                # can pack several buckets under stream.maxBytes — a
+                # bucket bigger than the whole budget degrades to
+                # one-bucket waves and the peak grows to O(bucket)
+                s_buckets = max(num_buckets, rung_rows // 4_000_000)
+                ssession = HyperspaceSession()
+                ssession.conf.set(
+                    C.INDEX_SYSTEM_PATH, os.path.join(rung_dir, "indexes")
+                )
+                ssession.conf.set(C.INDEX_NUM_BUCKETS, s_buckets)
+                shs = Hyperspace(ssession)
+                sldf = ssession.read.parquet(sldir)
+                sodf = ssession.read.parquet(sodir)
+                shs.create_index(
+                    sldf,
+                    CoveringIndexConfig(
+                        "stream_l_idx", ["l_orderkey"], ["l_quantity"]
+                    ),
+                )
+                shs.create_index(
+                    sodf,
+                    CoveringIndexConfig(
+                        "stream_o_idx", ["o_orderkey"], ["o_custkey"]
+                    ),
+                )
+                ssession.enable_hyperspace()
+
+                def q_sjoin(o=sodf, i=sldf):
+                    return o.join(
+                        i, on=o["o_orderkey"] == i["l_orderkey"]
+                    ).select("o_orderkey", "o_custkey", "l_quantity")
+
+                splan = q_sjoin().explain()
+                if splan.count("Hyperspace(Type: CI") != 2:
+                    log(
+                        f"WARNING: stream rung join not index-served:"
+                        f"\n{splan}"
+                    )
+                base_row = None
+                if rung_rows <= baseline_max:
+                    t0 = time.perf_counter()
+                    base_rows = q_sjoin().collect().num_rows
+                    base_wall = time.perf_counter() - t0
+                    base_row = {
+                        "wall_s": round(base_wall, 3),
+                        "rows_out": base_rows,
+                        "serve_stage_ms": {
+                            k: round(v * 1e3, 2)
+                            for k, v in (
+                                join_exec.last_serve_breakdown.items()
+                            )
+                        },
+                        "rss_high_water_bytes": rss_hwm(),
+                    }
+                # spill round-trip at rung scale (docs/out-of-core.md):
+                # measure one side's decoded filter state, then size the
+                # cache to hold exactly that — serving the other side
+                # demotes it to the spill tier and the re-serve restores
+                # it as a zero-copy mmap view
+                ssession.conf.set(C.SERVE_CACHE_ENABLED, True)
+                ssession.conf.set(C.SERVE_SPILL_MAX_BYTES, 2 << 30)
+                ssession.conf.set(C.IO_MMAP_ENABLED, True)
+                k_l = int(max(rung_rows // 8, 1) // 3)
+
+                def q_sfilter_l(i=sldf, k=k_l):
+                    return i.filter(i["l_orderkey"] == k).select(
+                        "l_orderkey", "l_quantity"
+                    )
+
+                def q_sfilter_o(o=sodf, k=k_l):
+                    return o.filter(o["o_orderkey"] == k).select(
+                        "o_orderkey", "o_custkey"
+                    )
+
+                l_rows = q_sfilter_l().collect().num_rows
+                resident = ssession.serve_cache.stats()["resident_bytes"]
+                if resident > 0:
+                    # rebuilds the cache at the tight budget
+                    ssession.conf.set(
+                        C.SERVE_CACHE_MAX_BYTES, resident + 64
+                    )
+                    assert q_sfilter_l().collect().num_rows == l_rows
+                    q_sfilter_o().collect()  # displaces l -> demote
+                    assert q_sfilter_l().collect().num_rows == l_rows
+                ssession.conf.set(C.SERVE_STREAM_ENABLED, True)
+                # HS_BENCH_STREAM_MAX_BYTES: shrink the wave budget so
+                # tiny smoke rows still pack >1 wave (0 = conf default)
+                wave_budget = int(
+                    os.environ.get("HS_BENCH_STREAM_MAX_BYTES", 0)
+                )
+                if wave_budget > 0:
+                    ssession.conf.set(
+                        C.SERVE_STREAM_MAX_BYTES, wave_budget
+                    )
+                ex_mod.stream_stats_reset()
+                t0 = time.perf_counter()
+                s_rows = q_sjoin().collect().num_rows
+                s_wall = time.perf_counter() - t0
+                s_stats = dict(ex_mod.last_stream_stats)
+                cache_stats = ssession.serve_cache.stats()
+                row = {
+                    "rows": rung_rows,
+                    "num_buckets": s_buckets,
+                    "stream_wall_s": round(s_wall, 3),
+                    "rows_out": s_rows,
+                    "stream_waves": s_stats.get("stream_waves", 0),
+                    "stream_buckets": s_stats.get("stream_buckets", 0),
+                    "stream_stage_ms": {
+                        k: round(v * 1e3, 2)
+                        for k, v in join_exec.last_serve_breakdown.items()
+                    },
+                    "spill_demotes": cache_stats["spill_demotes"],
+                    "spill_restores": cache_stats["spill_restores"],
+                    "spill_bytes": cache_stats["spill_bytes"],
+                    "rss_high_water_bytes": rss_hwm(),
+                }
+                if base_row is not None:
+                    # cheap at-scale identity proxy; the byte-level
+                    # differential is tests/test_stream_serve.py
+                    assert s_rows == base_row["rows_out"], (
+                        s_rows,
+                        base_row["rows_out"],
+                    )
+                    row["materializing_baseline"] = base_row
+                    row["stream_speedup"] = round(
+                        base_row["wall_s"] / s_wall, 3
+                    )
+                stream_ladder.append(row)
+                log(
+                    f"stream ladder {rung_rows:,} rows: "
+                    f"{s_wall:.2f}s in {row['stream_waves']} waves "
+                    f"({row['stream_buckets']} buckets), "
+                    f"spill {row['spill_demotes']}/{row['spill_restores']} "
+                    f"demote/restore, rss hwm "
+                    f"{row['rss_high_water_bytes'] / 1e9:.2f}GB"
+                )
+            except MemoryError:
+                log(
+                    f"stream ladder {rung_rows:,} rows: skipped "
+                    f"(MemoryError)"
+                )
+            finally:
+                shutil.rmtree(rung_dir, ignore_errors=True)
+
         # headline: geometric mean of the three UNCACHED serve-path
         # speedups — stable under one path's unindexed baseline improving,
         # and directly comparable with rounds 1-4. The serve-server
@@ -1385,6 +1563,7 @@ def main() -> None:
                     "ds_prune_files_total": ds_total,
                     "build_ladder": ladder,
                     "mesh_ladder": mesh_ladder,
+                    "stream_ladder": stream_ladder,
                     "residency": residency,
                 }
             )

@@ -74,6 +74,7 @@ BOUND_CLASSES = (
     "chunk-bounded",
     "row-group-bounded",
     "const-bounded",
+    "spill-bounded",
 )
 
 #: top-level package dirs whose functions are the serve/build hot path
@@ -92,6 +93,10 @@ ARROW_PRIMS = frozenset(
 )
 #: numpy allocators keyed on a relation-derived shape argument
 NP_SHAPE_PRIMS = frozenset({"empty", "zeros", "ones", "full"})
+#: mmap materializers — bounded by construction (the bytes are
+#: file-backed views; resident charge is the page cache's problem), but
+#: still allocation sites the registry must be able to declare
+MMAP_PRIMS = frozenset({"frombuffer", "read_buffer", "memory_map"})
 #: concatenators — unbounded iff the concatenated value is tainted
 CONCAT_PRIMS = frozenset(
     {"concatenate", "vstack", "hstack", "stack", "concat_tables"}
@@ -438,7 +443,7 @@ def build_index(project: Project) -> Dict[FnKey, _Fn]:
                 )
                 if last in READ_PRIMS:
                     fn.allocs.append(_Alloc(node.lineno, last, True))
-                elif last in SLICE_READ_PRIMS:
+                elif last in SLICE_READ_PRIMS or last in MMAP_PRIMS:
                     fn.allocs.append(_Alloc(node.lineno, last, False))
                 elif isinstance(f, ast.Attribute) and f.attr in ARROW_PRIMS:
                     fn.allocs.append(
@@ -552,6 +557,7 @@ _HS1002_HINTS = {
     "row-group-bounded": "the site never touches the row-group read "
     "path (read_table_row_groups / row_groups selection)",
     "wave-budget": "the site references no wave/budget/pool machinery",
+    "spill-bounded": "the site references no spill/mmap machinery",
 }
 
 
@@ -609,6 +615,11 @@ def _bound_enforced(
     if bound == "wave-budget":
         return any(
             any(s in i for s in ("wave", "budget", "pool"))
+            for i in fn.idents
+        )
+    if bound == "spill-bounded":
+        return any(
+            any(s in i for s in ("spill", "mmap", "mapped", "memory_map"))
             for i in fn.idents
         )
     return True

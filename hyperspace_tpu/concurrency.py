@@ -127,6 +127,47 @@ SHARED_STATE: Dict[str, Tuple[str, str, str]] = {
         "byte ledger mutated only under the cache lock; resident_bytes "
         "is a documented unsynchronized telemetry probe",
     ),
+    "hyperspace_tpu.execution.serve_cache.ServeCache._spill": (
+        "self._lock",
+        "guarded",
+        "the spill-tier index (key -> (path, nbytes)): get/put/demote/"
+        "evict/clear mutate it only inside the cache lock; file I/O "
+        "(encode, fsync'd publish, restore) runs outside with the key "
+        "already removed, so a racing get just misses and re-derives",
+    ),
+    "hyperspace_tpu.execution.serve_cache.ServeCache._spill_bytes": (
+        "self._lock",
+        "guarded",
+        "byte ledger of the spill tier, mutated in the same critical "
+        "sections as _spill so the hyperspace.serve.spill.maxBytes cap "
+        "can never be overshot by a torn read-modify-write",
+    ),
+    "hyperspace_tpu.execution.serve_cache._mmap_regions": (
+        "hyperspace_tpu.execution.serve_cache._mmap_lock",
+        "guarded-writes",
+        "the file-backed address-range registry estimate_nbytes "
+        "consults: register (spill restore / open_mmap_table), "
+        "finalizer-driven unregister and range iteration hold the one "
+        "lock; the sizing hot path's `if _mmap_regions` emptiness probe "
+        "is a deliberate lock-free read — a stale answer only mis-sizes "
+        "one estimate by the mmap token",
+    ),
+    "hyperspace_tpu.execution.serve_cache._LIVE_CACHES": (
+        "",
+        "rebind-only",
+        "WeakSet of live caches consulted by the spill orphan reaper; "
+        "membership changes are single add() at construction (before "
+        "the cache is shared) plus GC-driven removal — CPython WeakSet "
+        "discard is atomic at that granularity, readers snapshot via "
+        "list() before iterating",
+    ),
+    "hyperspace_tpu.execution.executor.last_stream_stats": (
+        "hyperspace_tpu.execution.executor._stream_stats_lock",
+        "guarded",
+        "per-query streaming-join wave/bucket counters accumulated from "
+        "the wave worker threads; reset and add both hold the stream "
+        "stats lock (last-writer-wins by contract, like the breakdown)",
+    ),
     "hyperspace_tpu.serve.frontend.ServeFrontend._inflight": (
         "self._lock",
         "guarded",

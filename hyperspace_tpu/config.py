@@ -268,6 +268,53 @@ class Config:
         )
 
     @property
+    def serve_stream_enabled(self) -> bool:
+        """Streaming per-bucket join serve (docs/out-of-core.md):
+        prepared sides flow wave-by-wave under the stream byte budget
+        instead of materializing whole; bit-identical to the
+        materializing path."""
+        return self.get_bool(
+            C.SERVE_STREAM_ENABLED, C.SERVE_STREAM_ENABLED_DEFAULT
+        )
+
+    @property
+    def serve_stream_max_bytes(self) -> int:
+        """Wave budget: estimated decoded bytes of prepared buckets in
+        flight at once on the streaming join path."""
+        return max(
+            1,
+            self.get_int(
+                C.SERVE_STREAM_MAX_BYTES, C.SERVE_STREAM_MAX_BYTES_DEFAULT
+            ),
+        )
+
+    @property
+    def serve_spill_max_bytes(self) -> int:
+        """ServeCache on-disk spill tier byte cap (0 = spill off)."""
+        return max(
+            0,
+            self.get_int(
+                C.SERVE_SPILL_MAX_BYTES, C.SERVE_SPILL_MAX_BYTES_DEFAULT
+            ),
+        )
+
+    @property
+    def serve_spill_orphan_ttl_ms(self) -> int:
+        """Lease age after which orphaned spill files are reaped."""
+        return max(
+            1,
+            self.get_int(
+                C.SERVE_SPILL_ORPHAN_TTL_MS,
+                C.SERVE_SPILL_ORPHAN_TTL_MS_DEFAULT,
+            ),
+        )
+
+    @property
+    def io_mmap_enabled(self) -> bool:
+        """Memory-mapped Arrow/parquet reads (io/parquet.py)."""
+        return self.get_bool(C.IO_MMAP_ENABLED, C.IO_MMAP_ENABLED_DEFAULT)
+
+    @property
     def serve_max_concurrency(self) -> int:
         """Serve-frontend worker threads (0 = auto-size)."""
         n = self.get_int(

@@ -296,6 +296,52 @@ SERVE_CACHE_ENABLED_DEFAULT = False
 SERVE_CACHE_MAX_BYTES = "hyperspace.serve.cache.maxBytes"
 SERVE_CACHE_MAX_BYTES_DEFAULT = 4 << 30  # 4 GiB
 
+# -- out-of-core serve (docs/out-of-core.md) ---------------------------------
+# Streaming per-bucket join serve: prepared join sides are produced,
+# matched, expanded and released wave-by-wave instead of materializing
+# both whole prepared sides, so peak residency is one wave's buckets
+# (<= stream.maxBytes estimated) rather than the relation. Bit-identical
+# to the materializing path (differential-tested); the flag exists for
+# A/B timing and as an escape hatch.
+SERVE_STREAM_ENABLED = "hyperspace.serve.stream.enabled"
+SERVE_STREAM_ENABLED_DEFAULT = False
+
+# Wave budget for the streaming join path: the estimated decoded bytes
+# of prepared buckets held in flight at once. Estimates come from
+# parquet footer row counts x projected columns; waves are planned so
+# their estimate stays under this cap (a single oversized bucket still
+# runs alone — the bucket is the atom of residency).
+SERVE_STREAM_MAX_BYTES = "hyperspace.serve.stream.maxBytes"
+SERVE_STREAM_MAX_BYTES_DEFAULT = 256 << 20  # 256 MiB
+
+# Spill tier for the ServeCache (execution/serve_cache.py): evicted
+# prepared sides / decoded scans are demoted to fsync'd files under
+# <system.path>/_hyperspace_spill/ (atomic publish per utils/files.py)
+# and restored zero-copy (mmap + pickle5 out-of-band buffers) on the
+# next miss, instead of being re-derived from parquet. 0 = off (evict
+# to oblivion, the pre-spill behavior). The byte cap bounds the on-disk
+# tier; oldest spill files are deleted when it overflows.
+SERVE_SPILL_MAX_BYTES = "hyperspace.serve.spill.maxBytes"
+SERVE_SPILL_MAX_BYTES_DEFAULT = 0
+
+# Lease age for orphaned spill files: recovery's spill reaper
+# (metadata/recovery.py reap_spill_orphans) deletes spill files and
+# torn .tmp_spool_ temps whose mtime is older than this and that no
+# live ServeCache in this process claims. Crashed serve processes leak
+# spill files; the reaper is what makes the tier derived state, not
+# durable state.
+SERVE_SPILL_ORPHAN_TTL_MS = "hyperspace.serve.spill.orphanTtlMs"
+SERVE_SPILL_ORPHAN_TTL_MS_DEFAULT = 10 * 60 * 1000  # 10 minutes
+
+# Memory-mapped Arrow/parquet reads (io/parquet.py): pass
+# memory_map=True into pyarrow readers so file bytes enter as kernel
+# page-cache mappings. Parquet decode still copies (decompression), so
+# this mainly helps uncompressed/IPC payloads; the honest-accounting
+# half lives in serve_cache.estimate_nbytes, which charges mmap-backed
+# buffers as file-backed (near-zero resident).
+IO_MMAP_ENABLED = "hyperspace.io.mmap.enabled"
+IO_MMAP_ENABLED_DEFAULT = False
+
 # Range serve plane (executor._range_pruned_scan + indexes/zonemaps.py,
 # see docs/range-serve.md): zone-map pruning of index files and row
 # groups under range/Eq/In conjuncts, z-address range decomposition for
@@ -547,3 +593,11 @@ HYPERSPACE_PINS_DIR = "_hyperspace_pins"
 # path): <root>/_hyperspace_fleet/bus/ event files +
 # <root>/_hyperspace_fleet/spool/ single-flight claims and results.
 HYPERSPACE_FLEET_DIR = "_hyperspace_fleet"
+
+# ServeCache spill tier directory under the lake root:
+# <root>/_hyperspace_spill/<sha>.spill files. Derived state — fully
+# rebuildable from the index parquet — so the recovery plane's spill
+# reaper deletes orphans past hyperspace.serve.spill.orphanTtlMs and
+# gc_orphans/vacuum never quarantine the live dir (underscore-prefixed,
+# invisible to data and index scans like the other sidecar dirs).
+HYPERSPACE_SPILL_DIR = "_hyperspace_spill"

@@ -9,8 +9,9 @@ host fallback for expressions the device path does not cover.
 
 from __future__ import annotations
 
+import threading as _threading
 from functools import lru_cache as _lru_cache
-from typing import Optional, Set
+from typing import Dict, Optional, Set
 
 import numpy as np
 
@@ -224,6 +225,40 @@ def _serve_pipeline_on(session) -> bool:
     pipeline's thread fan-out is a serve-process feature, not a library
     default for one-shot embedding."""
     return session is not None and session.conf.serve_pipeline_enabled
+
+
+def _serve_stream_on(session) -> bool:
+    """Streaming per-bucket join serve (``hyperspace.serve.stream.enabled``,
+    default off — docs/out-of-core.md). Session-gated like the serve
+    pipeline: the wave loop's thread fan-out and byte budgeting are a
+    serve-process feature, not a library default for one-shot embedding."""
+    return session is not None and session.conf.serve_stream_enabled
+
+
+def _io_mmap_on(session) -> bool:
+    """Memory-mapped Arrow reads (``hyperspace.io.mmap.enabled``, default
+    off — docs/out-of-core.md): serve-path parquet reads borrow pages from
+    the OS file mapping instead of copying onto the heap."""
+    return session is not None and session.conf.io_mmap_enabled
+
+
+# Streaming-serve telemetry (docs/out-of-core.md): wave counters of the
+# LAST streamed join, reset at the start of each — the stream analogue of
+# ``join_exec.last_serve_breakdown`` (same process-global, last-writer-
+# wins diagnostic scope: bench.py and the smoke gate read it between
+# queries; concurrent streams only blur this attribution, never results).
+last_stream_stats: Dict[str, int] = {}
+_stream_stats_lock = _threading.Lock()
+
+
+def stream_stats_reset() -> None:
+    with _stream_stats_lock:
+        last_stream_stats.clear()
+
+
+def _stream_stats_add(key: str, amount: int = 1) -> None:
+    with _stream_stats_lock:
+        last_stream_stats[key] = last_stream_stats.get(key, 0) + amount
 
 
 def _serve_shards(session) -> int:
@@ -464,6 +499,18 @@ def _exec_join(plan: Join, needed: Set[str], session) -> ColumnarBatch:
         serve_breakdown_reset()
         l_keys = [l for l, _ in on]
         r_keys = [r for _, r in on]
+        if _serve_stream_on(session):
+            # Out-of-core serve (docs/out-of-core.md): buckets stream
+            # through in waves sized by hyperspace.serve.stream.maxBytes —
+            # prepared sides are produced, matched, expanded and RELEASED
+            # per wave instead of materialized whole. Returns None when
+            # either side's shape does not stream (this materializing
+            # path then runs unchanged).
+            streamed = _exec_join_streaming(
+                plan, needed, session, layout, on, l_needed, r_needed
+            )
+            if streamed is not None:
+                return streamed
         # Pipelined serve: both sides prepare CONCURRENTLY (each side's
         # per-bucket reads already overlap its prepare via the scan
         # pool). Gated on both children being clean index-scan shapes —
@@ -638,6 +685,285 @@ def _prepared_join_side(
     if key is not None:
         cache.put(key, prep, prep.nbytes)
     return prep
+
+
+def _stream_side_probe(plan: LogicalPlan, needed: Set[str], session, bucket_cols):
+    """Wave-streamable decomposition of one join side, or None when the
+    shape does not support streaming (the caller falls back to the
+    materializing path). Shape scope mirrors ``_exec_bucketed`` /
+    ``_bucket_stream``: a Project* chain over a clean multi-file index
+    Scan, optionally through one Hybrid-Scan append Union whose
+    appended-files delta is prepared ONCE up front (``_prepare_delta`` —
+    ratio-capped, so it is wave-independent fixed residency). The probe
+    reads only parquet footers: per-bucket row counts seed the wave
+    planner's byte estimates without touching data pages."""
+    from hyperspace_tpu.io.parquet import bucket_id_of_file
+
+    sel_chain = []  # Project selects outermost-first; applied reversed
+    node = plan
+    nd = set(needed)
+    while isinstance(node, Project):
+        cols = [c for c in node.columns if c in nd] or node.columns
+        sel_chain.append(cols)
+        nd = set(cols)
+        node = node.child
+    read_cols = None
+    delta_parts = None
+    inner_chain = []
+    if isinstance(node, Union):
+        cols = [c for c in node.output if c in nd] or node.output[:1]
+        read_cols = sorted(set(cols) | set(bucket_cols))
+        spec = _bucket_layout(node.left)
+        if spec is None:
+            return None
+        delta_parts = _prepare_delta(
+            node.right, read_cols, session, bucket_cols, spec[0]
+        )
+        inner = node.left
+        nd = set(read_cols)
+        while isinstance(inner, Project):
+            cols = [c for c in inner.columns if c in nd] or inner.columns
+            inner_chain.append(cols)
+            nd = set(cols)
+            inner = inner.child
+        node = inner
+    if not isinstance(node, Scan):
+        return None
+    rel = node.relation
+    groups: dict = {}
+    for f in rel.files:
+        b = bucket_id_of_file(f)
+        groups.setdefault(b, []).append(f)
+    streamable = (
+        rel.fmt in ("parquet", "delta", "iceberg")
+        and rel.excluded_file_ids is None
+        and not rel.file_partition_values
+        and len(rel.files) > 1
+        and None not in groups
+    )
+    if not streamable:
+        return None
+    scan_cols = [c for c in rel.column_names if c in nd] or (
+        rel.column_names[:1]
+    )
+    all_files = [f for b in sorted(groups) for f in groups[b]]
+    counts = pio.file_row_counts(all_files)
+    rows_of = dict(zip(all_files, counts))
+    bucket_rows = {b: sum(rows_of[f] for f in groups[b]) for b in groups}
+    return {
+        "rel": rel,
+        "groups": groups,
+        "scan_cols": scan_cols,
+        "bucket_rows": bucket_rows,
+        "sel_chain": sel_chain,
+        "inner_chain": inner_chain,
+        "read_cols": read_cols,
+        "delta_parts": delta_parts,
+    }
+
+
+def _stream_side_bytes(state) -> Dict[int, int]:
+    """Estimated decoded bytes per bucket for wave packing: footer row
+    counts × projected column count × 8 for the scan part (strings cost
+    more than 8 bytes/row — the budget is a planning estimate, and the
+    prepared side's reps/combined overhead rides on top; see
+    docs/out-of-core.md for tuning), plus the real size of any delta
+    part landing in the bucket."""
+    est = {
+        b: r * len(state["scan_cols"]) * 8
+        for b, r in state["bucket_rows"].items()
+    }
+    if state["delta_parts"]:
+        from hyperspace_tpu.execution.serve_cache import batch_nbytes
+
+        for b, part in state["delta_parts"].items():
+            est[b] = est.get(b, 0) + batch_nbytes(part)
+    return est
+
+
+def _stream_wave_side(state, wave, session):
+    """One wave's worth of one side: the clean-scan shape returns
+    ``(contiguous_batch, buckets, sizes)`` — a single threaded read whose
+    decoded table IS the bucket-ordered concatenation, handed to
+    ``prepare_join_side_contiguous`` with no per-bucket copies — while
+    the hybrid Union shape returns a per-bucket dict (index slices merged
+    with the precomputed delta parts, exactly the ``_exec_bucketed``
+    Union recipe)."""
+    import time as _t
+
+    from hyperspace_tpu.execution import join_exec as _je
+
+    groups = state["groups"]
+    rel = state["rel"]
+    in_scan = [b for b in wave if b in groups]
+    table = None
+    if in_scan:
+        files = [f for b in in_scan for f in groups[b]]
+        t0 = _t.perf_counter()
+        table = pio.read_table(
+            files, state["scan_cols"], rel.fmt,
+            memory_map=_io_mmap_on(session),
+        )
+        _je._stage_add("scan", t0)
+    if state["read_cols"] is None:
+        # clean index scan: decode the wave read once, select once
+        t0 = _t.perf_counter()
+        batch = ColumnarBatch.from_arrow(table)
+        for cols in reversed(state["sel_chain"]):
+            batch = batch.select(
+                [c for c in cols if c in batch.column_names]
+            )
+        _je._stage_add("prepare", t0)
+        sizes = [state["bucket_rows"][b] for b in in_scan]
+        return batch, in_scan, sizes
+    # hybrid shape: per-bucket slices like _exec_bucketed's fast path,
+    # inner selects, merge delta parts, outer selects
+    t0 = _t.perf_counter()
+    out = {}
+    pos = 0
+    for b in in_scan:
+        c = state["bucket_rows"][b]
+        bb = ColumnarBatch.from_arrow(table.slice(pos, c))
+        pos += c
+        for cols in reversed(state["inner_chain"]):
+            bb = bb.select([x for x in cols if x in bb.column_names])
+        out[b] = bb.select(state["read_cols"])
+    for b in wave:
+        part = state["delta_parts"].get(b)
+        if part is None:
+            continue
+        if b in out:
+            out[b] = ColumnarBatch.concat([out[b], part])
+        else:
+            out[b] = part
+    for cols in reversed(state["sel_chain"]):
+        out = {
+            b: bb.select([x for x in cols if x in bb.column_names])
+            for b, bb in out.items()
+        }
+    _je._stage_add("prepare", t0)
+    return out
+
+
+def _stream_wave_prepared(state, wave, key_cols, session):
+    """PreparedJoinSide for one side's wave (None for an empty wave)."""
+    from hyperspace_tpu.execution.join_exec import (
+        prepare_join_side,
+        prepare_join_side_contiguous,
+    )
+
+    side = _stream_wave_side(state, wave, session)
+    if isinstance(side, dict):
+        return prepare_join_side(side, key_cols) if side else None
+    batch, buckets, sizes = side
+    return prepare_join_side_contiguous(batch, tuple(buckets), sizes, key_cols)
+
+
+def _exec_join_streaming(
+    plan: Join, needed: Set[str], session, layout, on, l_needed, r_needed
+):
+    """Streaming per-bucket join serve: the bucket is the unit of
+    residency (docs/out-of-core.md). Common buckets are packed into WAVES
+    whose estimated decoded bytes across both sides fit the
+    ``hyperspace.serve.stream.maxBytes`` budget (an oversized bucket runs
+    as its own wave — correctness never depends on the estimate); each
+    wave is read, prepared, matched, expanded, and RELEASED before the
+    next wave's read begins, so peak prepared-side residency is one wave
+    instead of the whole join. Wave outputs concatenate in ascending
+    bucket order — bit-identical to the materializing path: buckets are
+    independent, per-wave null sentinels are re-verified exactly like the
+    full-side ones, and the presorted-bucket native fast path applies per
+    wave whenever it applied to the full side. Returns None when either
+    side's shape does not stream (caller falls back). This path
+    deliberately skips the joinside/bucketed serve-cache entries: the
+    point of streaming is sides too large to pin, and a wave-sized cache
+    entry would alias the materializing path's keys."""
+    import time as _t
+
+    from hyperspace_tpu.execution import join_exec as _je
+    from hyperspace_tpu.execution.join_exec import co_bucketed_join_prepared
+
+    num_buckets, l_bucket_cols, r_bucket_cols = layout
+    l_state = _stream_side_probe(plan.left, l_needed, session, l_bucket_cols)
+    if l_state is None:
+        return None
+    r_state = _stream_side_probe(plan.right, r_needed, session, r_bucket_cols)
+    if r_state is None:
+        return None
+    stream_stats_reset()
+    l_keys = [l for l, _ in on]
+    r_keys = [r for _, r in on]
+    l_est = _stream_side_bytes(l_state)
+    r_est = _stream_side_bytes(r_state)
+    # only buckets present on BOTH sides can produce pairs; one-sided
+    # buckets are never read at all (the materializing path reads them
+    # and then drops them at the common-bucket subset)
+    common = sorted(set(l_est) & set(r_est))
+    budget = session.conf.serve_stream_max_bytes
+    waves = []
+    cur: list = []
+    cur_bytes = 0
+    for b in common:
+        nb = l_est.get(b, 0) + r_est.get(b, 0)
+        if cur and cur_bytes + nb > budget:
+            waves.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(b)
+        cur_bytes += nb
+    if cur:
+        waves.append(cur)
+    mesh = session.runtime.mesh if session is not None else None
+    min_rows = (
+        session.conf.device_join_min_rows if session is not None else 0
+    )
+    parts = []
+    if waves:
+        from concurrent.futures import ThreadPoolExecutor
+
+        # both sides of a wave read+prepare concurrently (the same
+        # 2-worker side fan-out as the materializing pipelined path;
+        # trace.carry keeps their stage spans on the query's root span)
+        with ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="hs-stream"
+        ) as side_pool:
+            for wave in waves:
+                t0 = _t.perf_counter()
+                fl = side_pool.submit(
+                    _obs_trace.carry(_stream_wave_prepared),
+                    l_state, wave, l_keys, session,
+                )
+                fr = side_pool.submit(
+                    _obs_trace.carry(_stream_wave_prepared),
+                    r_state, wave, r_keys, session,
+                )
+                lp = fl.result()
+                rp = fr.result()
+                joined = (
+                    co_bucketed_join_prepared(
+                        lp, rp, on, mesh, min_rows,
+                        num_shards=_serve_shards(session),
+                    )
+                    if lp is not None and rp is not None
+                    else None
+                )
+                if joined is not None:
+                    parts.append(joined)
+                # lp/rp (and their reps/combined) release here — the wave
+                # is the residency high-water mark, not the join
+                lp = rp = None
+                _stream_stats_add("stream_waves")
+                _stream_stats_add("stream_buckets", len(wave))
+                _je._stage_add("stream_wave", t0)
+    if parts:
+        return ColumnarBatch.concat(parts)
+    import pyarrow as pa
+
+    schema = plan.schema()
+    out_cols = [c for c in plan.output if c in (needed | set(
+        [x for p in on for x in p]))]
+    return ColumnarBatch.from_arrow(
+        pa.table({c: pa.array([], type=schema[c]) for c in out_cols})
+    )
 
 
 def _literal_key_rep(value, arrow_type):
@@ -995,7 +1321,10 @@ def _exec_bucketed(
                         return dict(hit)
             ordered = [(b, f) for b in sorted(groups) for f in groups[b]]
             counts = pio.file_row_counts([f for _, f in ordered])
-            table = pio.read_table([f for _, f in ordered], cols, rel.fmt)
+            table = pio.read_table(
+                [f for _, f in ordered], cols, rel.fmt,
+                memory_map=_io_mmap_on(session),
+            )
             per_bucket = {}
             for (b, _f), c in zip(ordered, counts):
                 per_bucket[b] = per_bucket.get(b, 0) + c
@@ -1339,7 +1668,8 @@ def _exec_scan(
         )
     else:
         table = pio.read_table(
-            list(rel.files), read_cols, rel.fmt, filters=pushdown
+            list(rel.files), read_cols, rel.fmt, filters=pushdown,
+            memory_map=_io_mmap_on(session),
         )
     batch = ColumnarBatch.from_arrow(table)
     if rel.excluded_file_ids is not None:

@@ -375,6 +375,60 @@ def prepare_join_side(
     )
 
 
+def prepare_join_side_contiguous(
+    batch: ColumnarBatch,
+    wave_buckets: Tuple[int, ...],
+    sizes,
+    key_cols: List[str],
+) -> Optional[PreparedJoinSide]:
+    """Serve state from an ALREADY-CONTIGUOUS batch whose rows are ordered
+    by ascending bucket (``sizes[i]`` rows belong to ``wave_buckets[i]``) — the
+    streaming-wave twin of :func:`prepare_join_side`
+    (docs/out-of-core.md). A wave's single decoded table IS the
+    concatenation the materializing path would have built bucket by
+    bucket, so the per-bucket concat copy disappears entirely and only
+    the per-row passes remain: key reps, null mask, combine, and the same
+    boundary-exempt sortedness test. Bit-identical to
+    ``prepare_join_side`` over the equivalent per-bucket slices (reps/
+    nulls/combined are per-row functions; the concat of slices of a
+    contiguous batch is the batch). Returns None for an empty wave."""
+    from hyperspace_tpu.ops.join import combine_reps_np
+
+    if not wave_buckets:
+        return None
+    t0 = _time.perf_counter()
+    sizes = np.asarray(sizes, dtype=np.int64)
+    offs = np.concatenate([[0], np.cumsum(sizes)[:-1]]).astype(np.int64)
+    reps = batch.key_reps(key_cols)
+    nulls_m = batch.null_any(key_cols)
+    nulls = nulls_m if nulls_m.any() else None
+    combined = combine_reps_np(reps)
+    n = combined.shape[0]
+    if n <= 1:
+        sorted_buckets = True
+    else:
+        ge = combined[1:] >= combined[:-1]
+        # same cross-bucket boundary exemption as prepare_join_side:
+        # bucket boundaries need not be ordered relative to each other
+        starts = offs[1:]
+        cross_idx = starts[(starts > 0) & (starts < n)] - 1
+        if len(cross_idx):
+            ge = ge.copy()
+            ge[cross_idx] = True
+        sorted_buckets = bool(np.all(ge))
+    _stage_add("prepare", t0)
+    return PreparedJoinSide(
+        buckets=tuple(wave_buckets),
+        batch=batch,
+        sizes=sizes,
+        offs=offs,
+        reps=reps,
+        combined=combined,
+        nulls=nulls,
+        sorted_buckets=sorted_buckets,
+    )
+
+
 def prepare_join_side_pipelined(
     items: Iterable[Tuple[int, Callable[[], ColumnarBatch]]],
     key_cols: List[str],

@@ -112,7 +112,11 @@ class Column:
     @staticmethod
     def from_arrow(arr: pa.ChunkedArray | pa.Array) -> "Column":
         if isinstance(arr, pa.ChunkedArray):
-            arr = arr.combine_chunks()
+            # combine_chunks COPIES even with exactly one chunk, which
+            # would detach a memory-mapped column from its registered
+            # region (docs/out-of-core.md) — take the lone chunk's
+            # zero-copy view instead.
+            arr = arr.chunk(0) if arr.num_chunks == 1 else arr.combine_chunks()
         t = arr.type
         if _is_string(t):
             atype = t.value_type if pa.types.is_dictionary(t) else t
@@ -382,6 +386,30 @@ def _numpy_dtype_for(t: pa.DataType):
     except (NotImplementedError, TypeError):
         # pyarrow has no numpy analogue for this type (decimal, nested…)
         return np.int64
+
+
+def open_mmap_table(path: str) -> pa.Table:
+    """Zero-copy memory-mapped read of an Arrow IPC file: the returned
+    table's buffers are views into the OS file mapping, not heap copies,
+    and the mapping is registered with the residency accounting
+    (``execution/serve_cache.register_mapped_region``) so
+    ``estimate_nbytes`` charges these columns as file-backed views — the
+    read-side half of the out-of-core serve doctrine
+    (docs/out-of-core.md; the spill tier's restore path goes through the
+    same registry). The region unregisters itself when the table is
+    collected; until then every buffer whose address falls inside it is
+    charged the near-zero mapped-view token instead of its byte length."""
+    import pyarrow.ipc as ipc
+
+    from hyperspace_tpu.execution.serve_cache import register_mapped_region
+
+    source = pa.memory_map(path, "r")
+    size = source.size()
+    buf = source.read_buffer(size) if size else None
+    table = ipc.open_file(source).read_all()
+    if buf is not None and buf.size:
+        register_mapped_region(buf.address, buf.size, owner=table)
+    return table
 
 
 class ColumnarBatch:

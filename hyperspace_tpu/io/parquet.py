@@ -101,9 +101,19 @@ def read_table(
     columns: Optional[Sequence[str]] = None,
     fmt: str = "parquet",
     filters=None,
+    memory_map: bool = False,
 ) -> pa.Table:
     """Read and concatenate files into one Arrow table (row order follows
     ``paths`` order, file by file).
+
+    ``memory_map`` (parquet-like formats, ``hyperspace.io.mmap.enabled``)
+    routes the read through OS memory mapping: pyarrow then borrows
+    uncompressed/plain column chunks straight from the page cache instead
+    of copying them onto the heap, so decoded columns can stay file-backed
+    views (docs/out-of-core.md; the residency accounting in
+    ``execution/serve_cache.estimate_nbytes`` charges registered mapped
+    regions near zero). Row values are identical either way — mapping only
+    changes where the bytes live.
 
     ``filters`` (parquet-like formats only) is a pyarrow DNF conjunction.
     REQUIRED INVARIANT: each pushed conjunct must keep a **row-level
@@ -138,7 +148,7 @@ def read_table(
                 filters = [
                     f for f in filters if f[0] not in extract
                 ] or None
-            t = read_table(paths, read_cols, fmt, filters)
+            t = read_table(paths, read_cols, fmt, filters, memory_map)
             out = {}
             for c in columns:
                 if c in extract:
@@ -167,6 +177,7 @@ def read_table(
                 columns=list(columns) if columns else None,
                 filters=filters,
                 partitioning=None,
+                memory_map=memory_map,
             )
     tables = []
     for p in paths:
@@ -177,6 +188,7 @@ def read_table(
                     columns=list(columns) if columns else None,
                     filters=filters,
                     partitioning=None,
+                    memory_map=memory_map,
                 )
             )
         elif fmt == "csv":

@@ -53,6 +53,12 @@ class IndexCollectionManager:
                 conf.recovery_orphan_grace_ms,
                 lease_ms=conf.recovery_lease_ms,
             )
+            # the spill tier is lake-level derived state: reap expired
+            # files no live cache indexes (docs/out-of-core.md)
+            report["spill_gc"] = recovery.reap_spill_orphans(
+                self.path_resolver.system_path,
+                conf.serve_spill_orphan_ttl_ms,
+            )
         return report
 
     def recover_all(self, gc: bool = False) -> List[dict]:
@@ -73,6 +79,20 @@ class IndexCollectionManager:
                 )
             report["index_path"] = path
             out.append(report)
+        if gc:
+            spill_report = recovery.reap_spill_orphans(
+                self.path_resolver.system_path,
+                conf.serve_spill_orphan_ttl_ms,
+            )
+            # one lake-level summary row (the spill tier has no index);
+            # rolled_back=False keeps per-index report iteration shapes
+            out.append(
+                {
+                    "index_path": None,
+                    "rolled_back": False,
+                    "spill_gc": spill_report,
+                }
+            )
         return out
 
     # -- wiring -------------------------------------------------------------

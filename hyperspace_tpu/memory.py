@@ -50,6 +50,12 @@ module (import-time allocation). Bound classes:
     O(1) or O(schema) — statistics, offsets, per-file footers summary;
     grows with column/file *count* ceilings that config caps, never
     with row count. No structural check; the justification carries it.
+``spill-bounded``
+    Bounded by the on-disk spill tier budget
+    (``hyperspace.serve.spill.maxBytes``): the materialized value is a
+    zero-copy view of a memory-mapped spill file whose resident charge
+    is the O(1) mmap token, with real residency governed by the page
+    cache. HS1002 requires the site to reference the spill machinery.
 
 The witness gates each class against ``BOUND_CLASS_CEILINGS`` below:
 an observed per-site peak past its class ceiling is a hard HS1004
@@ -68,13 +74,14 @@ from typing import Dict, Tuple
 #: planes an allocation site may run on
 PLANES = ("build", "serve", "maintenance")
 
-#: the five declared bound classes (see module doc)
+#: the six declared bound classes (see module doc)
 BOUND_CLASSES = (
     "cache-governed",
     "wave-budget",
     "chunk-bounded",
     "row-group-bounded",
     "const-bounded",
+    "spill-bounded",
 )
 
 #: per-class byte ceilings the runtime witness gates on (HS1004): an
@@ -88,6 +95,7 @@ BOUND_CLASS_CEILINGS: Dict[str, int] = {
     "chunk-bounded": 512 << 20,
     "row-group-bounded": 256 << 20,
     "const-bounded": 64 << 20,
+    "spill-bounded": 512 << 20,
 }
 
 ALLOC_SITES: Dict[str, Tuple[str, str, str]] = {
@@ -200,6 +208,38 @@ ALLOC_SITES: Dict[str, Tuple[str, str, str]] = {
         "reads the planner's pruned selection (row-group-narrowed when "
         "zone maps supply file_row_groups); the decoded batch becomes "
         "the scan cache entry the governor charges",
+    ),
+    # -- out-of-core streaming serve (hyperspace.serve.stream.*) -------------
+    "hyperspace_tpu.execution.executor._stream_wave_side": (
+        "serve",
+        "wave-budget",
+        "reads exactly one wave's bucket files — waves are packed by "
+        "_exec_join_streaming so both sides' estimated decoded bytes "
+        "fit hyperspace.serve.stream.maxBytes — and the prepared wave "
+        "is released as soon as its join output is assembled",
+    ),
+    "hyperspace_tpu.execution.join_exec.prepare_join_side_contiguous": (
+        "serve",
+        "wave-budget",
+        "zero-concat prepared side over one already-contiguous wave "
+        "batch: allocates only the O(wave) key/offset arrays beside "
+        "the batch the wave reader materialized under the budget",
+    ),
+    # -- spill tier (hyperspace.serve.spill.*) -------------------------------
+    "hyperspace_tpu.execution.serve_cache.ServeCache._restore_from_spill": (
+        "serve",
+        "spill-bounded",
+        "restored values are zero-copy read-only views of the mmap'd "
+        "spill file (resident charge = the O(1) mmap token); real "
+        "pages belong to the kernel page cache, and the tier's total "
+        "bytes are capped by hyperspace.serve.spill.maxBytes",
+    ),
+    "hyperspace_tpu.io.columnar.open_mmap_table": (
+        "serve",
+        "spill-bounded",
+        "memory-maps an arrow IPC file and registers the region so "
+        "estimate_nbytes charges views of it as file-backed tokens; "
+        "residency is governed by the page cache, not the heap",
     ),
     # -- aggregate / sample plane (approximate answers) ----------------------
     "hyperspace_tpu.indexes.aggindex.prune_missing": (

@@ -44,4 +44,7 @@ class PathResolver:
             os.path.join(root, n)
             for n in sorted(os.listdir(root))
             if os.path.isdir(os.path.join(root, n))
+            # lake-level service dirs (the spill tier, and any future
+            # _hyperspace_* sidecar) are not indexes
+            and not n.startswith("_hyperspace")
         ]

@@ -57,6 +57,8 @@ from hyperspace_tpu.constants import (
     HYPERSPACE_LOG_DIR,
     HYPERSPACE_PINS_DIR,
     HYPERSPACE_QUARANTINE_DIR,
+    HYPERSPACE_SPILL_DIR,
+    SERVE_SPILL_ORPHAN_TTL_MS_DEFAULT,
     INDEX_VERSION_DIR_PREFIX,
     RECOVERY_LEASE_MS_DEFAULT,
     RECOVERY_ORPHAN_GRACE_MS_DEFAULT,
@@ -620,6 +622,7 @@ def find_orphans(index_path: str) -> List[str]:
             HYPERSPACE_LOG_DIR,
             HYPERSPACE_QUARANTINE_DIR,
             HYPERSPACE_PINS_DIR,
+            HYPERSPACE_SPILL_DIR,
         ):
             continue
         root = os.path.join(index_path, name)
@@ -702,6 +705,7 @@ def gc_orphans(
             HYPERSPACE_LOG_DIR,
             HYPERSPACE_QUARANTINE_DIR,
             HYPERSPACE_PINS_DIR,
+            HYPERSPACE_SPILL_DIR,
         ):
             continue
         root = os.path.join(index_path, name)
@@ -750,3 +754,63 @@ def _purge_quarantine(
             report["purged_stamps"] += 1
     if not os.listdir(quarantine_root):
         file_utils.delete(quarantine_root)
+
+
+def reap_spill_orphans(
+    system_path: str,
+    ttl_ms: int = SERVE_SPILL_ORPHAN_TTL_MS_DEFAULT,
+    now: Optional[int] = None,
+) -> Dict[str, int]:
+    """Delete expired spill-tier leavings under
+    ``<system_path>/_hyperspace_spill/`` (docs/out-of-core.md).
+
+    Spill files are DERIVED state: every byte is reproducible from
+    parquet, so the reaper deletes rather than quarantines — the
+    ``gc_orphans`` move-then-grace dance exists to protect source-of-
+    truth index data, which spill files never are. Three protections
+    keep a live serve unharmed:
+
+    * files a live in-process :class:`~hyperspace_tpu.execution\
+.serve_cache.ServeCache` still indexes (``live_spill_paths()``) are
+      never touched, mirroring the serve-pin exemption of
+      :func:`gc_orphans`;
+    * files younger than ``ttl_ms`` (``hyperspace.serve.spill\
+.orphanTtlMs``) are kept — a sibling process's cache may index them,
+      and a freshly published file is by definition younger than its
+      writer's next eviction cycle;
+    * deletion races are benign by construction: a restore that loses
+      the race sees a vanished file and degrades to a cache miss.
+
+    Torn ``.tmp_spool_*`` temps from a writer that died mid-publish
+    (the ``mid_spill_write`` crash point) age out the same way.
+    Idempotent; returns ``{"reaped": n, "kept_live": n, "kept_young":
+    n}``.
+    """
+    from hyperspace_tpu.execution.serve_cache import live_spill_paths
+
+    report = {"reaped": 0, "kept_live": 0, "kept_young": 0}
+    spill_dir = os.path.join(system_path, HYPERSPACE_SPILL_DIR)
+    if not os.path.isdir(spill_dir):
+        return report
+    now = now_ms() if now is None else now
+    live = live_spill_paths()
+    for name in sorted(os.listdir(spill_dir)):
+        if not (name.endswith(".spill") or name.startswith(".tmp_spool_")):
+            continue
+        path = os.path.join(spill_dir, name)
+        if path in live:
+            report["kept_live"] += 1
+            continue
+        try:
+            age_ms = now - int(os.path.getmtime(path) * 1000)
+        except OSError:
+            continue  # vanished under us — someone else reaped it
+        if age_ms < ttl_ms:
+            report["kept_young"] += 1
+            continue
+        try:
+            file_utils.delete(path)
+            report["reaped"] += 1
+        except OSError:
+            pass
+    return report

@@ -59,6 +59,11 @@ SERVE_STAGES = (
     "agg",
     "finalize",
     "execute",
+    # out-of-core serve (docs/out-of-core.md): one span per streaming
+    # join wave, and the spill tier's demote/restore I/O
+    "stream_wave",
+    "spill_write",
+    "spill_restore",
 )
 
 #: build/lifecycle stage spans — the last_build_breakdown keys plus the
@@ -112,6 +117,17 @@ OBS_SITES: Dict[str, Tuple[str, str]] = {
         "view",
         "the memory governor's stats() export live through the "
         "registry, same single-owner discipline as the frontend",
+    ),
+    "hyperspace_tpu.execution.serve_cache.ServeCache._spill_demote": (
+        "span",
+        "spill_write is pickle + fsync'd publish outside every "
+        "breakdown stage — unexplained serve tail time under memory "
+        "pressure must be attributable to the spill tier",
+    ),
+    "hyperspace_tpu.execution.serve_cache.ServeCache._restore_from_spill": (
+        "span",
+        "spill_restore makes the cost of serving from the disk tier "
+        "visible next to the scan/prepare stages it displaces",
     ),
     "hyperspace_tpu.execution.join_exec": (
         "metric",

@@ -180,14 +180,25 @@ class HyperspaceSession:
         if not self.conf.serve_cache_enabled:
             return None
         max_bytes = self.conf.serve_cache_max_bytes
+        spill_max_bytes = self.conf.serve_spill_max_bytes
         with self._serve_cache_lock:
             if (
                 self._serve_cache is None
                 or self._serve_cache.max_bytes != max_bytes
+                or self._serve_cache.spill_max_bytes != spill_max_bytes
             ):
-                from hyperspace_tpu.execution.serve_cache import ServeCache
+                from hyperspace_tpu.execution.serve_cache import (
+                    ServeCache,
+                    spill_root,
+                )
 
-                self._serve_cache = ServeCache(max_bytes)
+                self._serve_cache = ServeCache(
+                    max_bytes,
+                    spill_dir=(
+                        spill_root(self.conf) if spill_max_bytes > 0 else None
+                    ),
+                    spill_max_bytes=spill_max_bytes,
+                )
             return self._serve_cache
 
     def clear_serve_cache(self) -> None:

@@ -108,6 +108,7 @@ CRASH_POINTS = (
     "mid_vacuum_delete",
     "mid_sidecar_publish",
     "mid_querylog_rotate",
+    "mid_spill_write",
 )
 
 #: ``exit``-mode crash status — distinctive, so a subprocess test can tell

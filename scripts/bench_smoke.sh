@@ -69,6 +69,8 @@ HS_BENCH_MESH_ROWS="$ROWS" \
 HS_BENCH_FLEET="${HS_BENCH_FLEET:-2}" \
 HS_BENCH_FLEET_ITERS="${HS_BENCH_FLEET_ITERS:-4}" \
 HS_BENCH_FLEET_ROWS="${HS_BENCH_FLEET_ROWS:-20000}" \
+HS_BENCH_STREAM_LADDER="$ROWS" \
+HS_BENCH_STREAM_MAX_BYTES="${HS_BENCH_STREAM_MAX_BYTES:-65536}" \
 python bench.py)
 echo "$OUT"
 test -s "$RESW" || { echo "bench_smoke: residency witness artifact missing" >&2; exit 1; }
@@ -229,4 +231,25 @@ for r in d["build_ladder"] + d["mesh_ladder"]:
 print("bench_smoke: residency telemetry ok:",
       {"rss_high_water_bytes": res["rss_high_water_bytes"],
        "witnessed_sites": res["witnessed_sites"]}, file=sys.stderr)
+# the out-of-core streaming rung (docs/out-of-core.md): the tiny wave
+# budget must have packed the join into MULTIPLE waves (the streaming
+# path actually ran, not the materializing fallback), the spill tier
+# must have round-tripped at least one demote AND restore, and the
+# output must equal the materializing baseline row for row. Bound-class
+# violations are impossible here by construction: the residency-witness
+# cross-check above already gated the whole run (incl. the wave-budget
+# and spill-bounded sites) against the ALLOC_SITES model
+st = d["stream_ladder"]
+assert st, "stream ladder rows missing"
+for r in st:
+    assert r["stream_waves"] > 1, f"streaming path did not wave-pack: {r}"
+    assert r["stream_buckets"] >= r["stream_waves"], r
+    assert r["spill_demotes"] >= 1, f"spill tier never demoted: {r}"
+    assert r["spill_restores"] >= 1, f"spill tier never restored: {r}"
+    assert r["stream_stage_ms"].get("stream_wave", 0) > 0, r
+    assert r["rows_out"] == r["materializing_baseline"]["rows_out"], r
+    assert r["rss_high_water_bytes"] > 0, r
+print("bench_smoke: out-of-core stream ok:",
+      [(r["rows"], r["stream_waves"], r["spill_demotes"],
+        r["spill_restores"]) for r in st], file=sys.stderr)
 '

@@ -1107,3 +1107,67 @@ class TestCrossProcessPins:
             f.write('{"owner": "dead", "expi')  # torn
         assert recovery.durable_pinned_files(log_mgr.index_path) == set()
         assert not os.path.isdir(pins_dir) or not os.listdir(pins_dir)
+
+
+class TestSpillWriteCrash:
+    """``mid_spill_write``: a demotion killed between choosing the spill
+    path and the atomic publish leaves no final ``.spill`` file — at
+    most a ``.tmp_spool_`` temp the orphan reaper deletes — so a torn
+    spill is never served and the tier heals on the next demote
+    (docs/out-of-core.md)."""
+
+    def _batch(self, n=2_000):
+        import numpy as np
+
+        rng = np.random.default_rng(11)
+        from hyperspace_tpu.io.columnar import ColumnarBatch
+
+        return ColumnarBatch.from_arrow(
+            pa.table(
+                {
+                    "k": rng.integers(0, 50, n).astype(np.int64),
+                    "v": rng.normal(0, 1, n),
+                }
+            )
+        )
+
+    def test_crash_mid_spill_write_never_serves_torn_state(self, tmp_path):
+        from hyperspace_tpu.execution.serve_cache import (
+            ServeCache,
+            batch_nbytes,
+        )
+
+        spill_dir = tmp_path / C.HYPERSPACE_SPILL_DIR
+        batch = self._batch()
+        nb = batch_nbytes(batch)
+        c = ServeCache(
+            max_bytes=nb + 16,
+            spill_dir=str(spill_dir),
+            spill_max_bytes=1 << 30,
+        )
+        c.put(("scan", "fp-a", ("k",)), batch, nb)
+        faults.set_crash("mid_spill_write", "raise")
+        # displacing fp-a pushes its demotion across the crash seam
+        with pytest.raises(SimulatedCrash):
+            c.put(("zonemap", "fp-b"), "displacer", nb)
+        assert faults.stats().get("crash.mid_spill_write", 0) == 1
+        faults.reset()
+        # no torn final file was published, and the key is a clean miss
+        # — the crashed demotion is never served
+        if spill_dir.is_dir():
+            assert not [
+                p for p in os.listdir(spill_dir) if p.endswith(".spill")
+            ]
+        assert c.spill_paths() == set()
+        assert c.get(("scan", "fp-a", ("k",))) is None
+        # the reaper clears whatever wreckage remains (ttl=0: everything
+        # not indexed by a live cache is expired)
+        recovery.reap_spill_orphans(str(tmp_path), ttl_ms=0)
+        assert not spill_dir.is_dir() or not os.listdir(spill_dir)
+        # the tier heals: a retried demote + restore round-trips
+        c.put(("scan", "fp-a", ("k",)), batch, nb)
+        c.put(("zonemap", "fp-c"), "displacer", nb)
+        assert c.spill_demotes == 1
+        restored = c.get(("scan", "fp-a", ("k",)))
+        assert restored is not None
+        assert restored.to_arrow().equals(batch.to_arrow())

@@ -161,9 +161,9 @@ class TestRowGroupPushdown:
         captured = []
         real = pio.read_table
 
-        def capture(paths, columns=None, fmt="parquet", filters=None):
+        def capture(paths, columns=None, fmt="parquet", filters=None, **kw):
             captured.append(filters)
-            return real(paths, columns, fmt, filters)
+            return real(paths, columns, fmt, filters, **kw)
 
         monkeypatch.setattr(
             "hyperspace_tpu.execution.executor.pio.read_table", capture
@@ -465,9 +465,9 @@ class TestLimitPushdown:
         seen = []
         real = pio.read_table
 
-        def counting(paths, columns=None, fmt="parquet", filters=None):
+        def counting(paths, columns=None, fmt="parquet", filters=None, **kw):
             seen.extend(paths)
-            return real(paths, columns, fmt, filters)
+            return real(paths, columns, fmt, filters, **kw)
 
         monkeypatch.setattr(
             "hyperspace_tpu.execution.executor.pio.read_table", counting

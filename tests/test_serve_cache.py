@@ -850,3 +850,166 @@ class TestMemoryGovernor:
         assert c.high_water_bytes <= c.max_bytes
         c.evict_kind("scan")  # drain whatever landed after the storm
         assert c.evict_kind("scan") == 0  # and a second pass finds nothing
+
+
+class TestSpillTier:
+    """The spill-aware governor (docs/out-of-core.md): cold data-plane
+    entries demote to fsync'd files under ``_hyperspace_spill/`` instead
+    of evicting to oblivion, restore bit-identically as zero-copy mmap
+    views, and the tier itself is byte-capped LRU."""
+
+    def _batch(self, seed=5, n=4_000):
+        rng = np.random.default_rng(seed)
+        return ColumnarBatch.from_arrow(
+            pa.table(
+                {
+                    "k": rng.integers(0, 100, n).astype(np.int64),
+                    "v": rng.normal(0, 1, n),
+                    "tag": pa.array(rng.choice(["x", "y", "z"], n)),
+                }
+            )
+        )
+
+    def _spilled_cache(self, tmp_path, batch):
+        """A cache sized so inserting a second entry demotes the first."""
+        nb = batch_nbytes(batch)
+        c = ServeCache(
+            max_bytes=nb + 16,
+            spill_dir=str(tmp_path / "_hyperspace_spill"),
+            spill_max_bytes=1 << 30,
+        )
+        c.put(("scan", "fp-a", ("k",)), batch, nb)
+        # zonemap is not a spill kind: promoting fp-a back on restore
+        # displaces this entry to oblivion, not to a second spill file
+        c.put(("zonemap", "fp-b"), "displacer", nb)
+        return c
+
+    def test_demote_restore_bit_identical(self, tmp_path):
+        batch = self._batch()
+        c = self._spilled_cache(tmp_path, batch)
+        assert c.spill_demotes == 1
+        paths = c.spill_paths()
+        assert len(paths) == 1 and all(os.path.exists(p) for p in paths)
+        restored = c.get(("scan", "fp-a", ("k",)))
+        assert restored is not None
+        assert restored.to_arrow().equals(batch.to_arrow())
+        assert c.spill_restores == 1
+        # restore unlinks the file; the live mapping keeps its pages
+        assert not any(os.path.exists(p) for p in paths)
+        # the mmap-aware ruler charges views, not decoded heap bytes
+        assert estimate_nbytes(restored) < batch_nbytes(batch) / 4
+        st = c.stats()
+        assert st["spill_demotes"] == 1 and st["spill_restores"] == 1
+        assert st["spill_bytes"] > 0
+
+    def test_torn_spill_file_degrades_to_miss(self, tmp_path):
+        batch = self._batch()
+        c = self._spilled_cache(tmp_path, batch)
+        (path,) = c.spill_paths()
+        with open(path, "wb") as f:
+            f.write(b"HSSP1\0garbage")  # torn: magic ok, body junk
+        assert c.get(("scan", "fp-a", ("k",))) is None
+        assert c.spill_drops == 1
+        assert not os.path.exists(path)  # wreckage reaped
+
+    def test_spill_tier_byte_cap_reaps_oldest(self, tmp_path):
+        batch = self._batch()
+        nb = batch_nbytes(batch)
+        blob_est = len(
+            __import__(
+                "hyperspace_tpu.execution.serve_cache",
+                fromlist=["_spill_encode"],
+            )._spill_encode(batch)
+        )
+        c = ServeCache(
+            max_bytes=nb + 16,
+            spill_dir=str(tmp_path / "_hyperspace_spill"),
+            spill_max_bytes=int(blob_est * 1.5),  # room for ONE blob
+        )
+        for i in range(3):
+            c.put(("scan", f"fp-{i}", ("k",)), self._batch(seed=i), nb)
+        assert c.spill_demotes == 2
+        assert len(c.spill_paths()) == 1  # cap held: oldest reaped
+        assert c.stats()["spill_resident_bytes"] <= int(blob_est * 1.5)
+
+    def test_unspillable_value_dropped_not_crashed(self, tmp_path):
+        nb = 1_000
+        c = ServeCache(
+            max_bytes=nb + 16,
+            spill_dir=str(tmp_path / "_hyperspace_spill"),
+            spill_max_bytes=1 << 30,
+        )
+        c.put(("scan", "fp-a"), lambda: None, nb)  # refuses to pickle
+        c.put(("scan", "fp-b"), "displacer", nb)
+        assert c.spill_drops == 1
+        assert c.get(("scan", "fp-a")) is None
+        assert c.spill_paths() == set()
+
+    def test_metadata_kinds_evict_to_oblivion(self, tmp_path):
+        nb = 1_000
+        c = ServeCache(
+            max_bytes=nb + 16,
+            spill_dir=str(tmp_path / "_hyperspace_spill"),
+            spill_max_bytes=1 << 30,
+        )
+        c.put(("zonemap", "fp-a"), {"z": 1}, nb)
+        c.put(("scan", "fp-b"), "displacer", nb)
+        assert c.spill_demotes == 0  # zonemap is not a spill kind
+        assert c.get(("zonemap", "fp-a")) is None
+
+    def test_clear_empties_spill_tier(self, tmp_path):
+        batch = self._batch()
+        c = self._spilled_cache(tmp_path, batch)
+        paths = c.spill_paths()
+        assert paths
+        c.clear()
+        assert c.spill_paths() == set()
+        assert not any(os.path.exists(p) for p in paths)
+
+
+class TestMmapEstimate:
+    """Satellite of the zero-copy read path: estimate_nbytes charges
+    views over a registered memory-mapped region as O(1) tokens, so the
+    governor never double-counts the kernel page cache as heap."""
+
+    def test_open_mmap_table_charges_tokens(self, tmp_path):
+        import pyarrow.ipc as ipc
+
+        from hyperspace_tpu.io.columnar import open_mmap_table
+
+        n = 200_000
+        t = pa.table({"k": pa.array(range(n), type=pa.int64())})
+        path = str(tmp_path / "t.arrow")
+        with ipc.new_file(path, t.schema) as w:
+            w.write_table(t)
+        heap_copy = pa.table({"k": pa.array(range(n), type=pa.int64())})
+        assert estimate_nbytes(heap_copy) >= n * 8
+        mapped = open_mmap_table(path)
+        assert mapped.equals(heap_copy)  # same bytes, different backing
+        assert estimate_nbytes(mapped) < n  # tokens, not 1.6 MB of heap
+        # a batch decoded zero-copy over the mapping stays token-priced
+        batch = ColumnarBatch.from_arrow(mapped)
+        assert estimate_nbytes(batch) < n
+
+    def test_mapped_region_retires_with_owner(self, tmp_path):
+        import gc
+
+        import pyarrow.ipc as ipc
+
+        from hyperspace_tpu.execution import serve_cache as sc
+        from hyperspace_tpu.io.columnar import open_mmap_table
+
+        t = pa.table({"k": pa.array(range(50_000), type=pa.int64())})
+        path = str(tmp_path / "t.arrow")
+        with ipc.new_file(path, t.schema) as w:
+            w.write_table(t)
+        # other tests' mappings may still be registered until their
+        # finalizers run — track THIS mapping's address, not the count
+        gc.collect()
+        before = set(sc._mmap_regions)
+        mapped = open_mmap_table(path)
+        new = set(sc._mmap_regions) - before
+        assert len(new) == 1
+        del mapped
+        gc.collect()
+        assert not (new & set(sc._mmap_regions))  # finalizer retired it

@@ -1,0 +1,318 @@
+"""Streaming per-bucket join serve (docs/out-of-core.md).
+
+Differential doctrine, three ways: the streaming serve (per-bucket
+waves packed under ``hyperspace.serve.stream.maxBytes``, read →
+prepare → match → release) must return BIT-IDENTICAL results to the
+materializing path, which must itself match the unindexed answer —
+across int64/float64/string payloads, string JOIN keys, hybrid-scan
+appended deltas and lineage delete compensation. The wave machinery is
+proven real with the ``executor.last_stream_stats`` telemetry (a small
+budget must produce many waves), not just plumbing.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+from hyperspace_tpu.io.columnar import ColumnarBatch
+
+
+@pytest.fixture
+def s1(session_factory):
+    return session_factory(1)
+
+
+def sorted_table(t: pa.Table) -> pa.Table:
+    return t.sort_by([(c, "ascending") for c in t.column_names])
+
+
+def _tables(tmp_path, n=40_000, n_orders=5_000, n_files=4):
+    rng = np.random.default_rng(17)
+    idir, odir = tmp_path / "items", tmp_path / "orders"
+    idir.mkdir()
+    odir.mkdir()
+    items = pa.table(
+        {
+            "k": rng.integers(0, n_orders, n).astype(np.int64),
+            "q": rng.integers(1, 51, n).astype(np.int64),
+            "price": rng.normal(100.0, 10.0, n),
+            "tag": pa.array(
+                rng.choice(["alpha", "beta", "gamma", "delta"], n)
+            ),
+        }
+    )
+    orders = pa.table(
+        {
+            "ok": np.arange(n_orders, dtype=np.int64),
+            "cust": rng.integers(0, 500, n_orders).astype(np.int64),
+        }
+    )
+    for i in range(n_files):
+        lo, hi = i * n // n_files, (i + 1) * n // n_files
+        pq.write_table(items.slice(lo, hi - lo), str(idir / f"p{i}.parquet"))
+        lo = i * n_orders // n_files
+        hi = (i + 1) * n_orders // n_files
+        pq.write_table(orders.slice(lo, hi - lo), str(odir / f"p{i}.parquet"))
+    return str(idir), str(odir)
+
+
+def _indexed_session(s, idir, odir):
+    hs = Hyperspace(s)
+    items = s.read.parquet(idir)
+    orders = s.read.parquet(odir)
+    hs.create_index(
+        items, CoveringIndexConfig("i1", ["k"], ["q", "price", "tag"])
+    )
+    hs.create_index(orders, CoveringIndexConfig("o1", ["ok"], ["cust"]))
+    s.enable_hyperspace()
+    return hs, items, orders
+
+
+def _join(s, orders, items):
+    return (
+        orders.join(items, on=orders["ok"] == items["k"])
+        .select("ok", "cust", "q", "price", "tag")
+        .collect()
+    )
+
+
+class TestStreamBitIdentity:
+    """stream on ≡ stream off ≡ unindexed — the three-way differential."""
+
+    def test_multiwave_three_way_differential(self, s1, tmp_path):
+        from hyperspace_tpu.execution import executor as ex
+
+        idir, odir = _tables(tmp_path)
+        _, items, orders = _indexed_session(s1, idir, odir)
+        s1.conf.set(C.SERVE_STREAM_ENABLED, True)
+        s1.conf.set(C.SERVE_STREAM_MAX_BYTES, 64_000)  # force many waves
+        r_stream = _join(s1, orders, items)
+        stats = dict(ex.last_stream_stats)
+        assert stats.get("stream_waves", 0) > 1, stats
+        s1.conf.set(C.SERVE_STREAM_ENABLED, False)
+        r_mat = _join(s1, orders, items)
+        assert r_stream.equals(r_mat)  # rows AND order
+        s1.disable_hyperspace()
+        r_plain = _join(s1, orders, items)
+        assert sorted_table(r_stream).equals(sorted_table(r_plain))
+
+    def test_single_wave_identical(self, s1, tmp_path):
+        idir, odir = _tables(tmp_path)
+        _, items, orders = _indexed_session(s1, idir, odir)
+        s1.conf.set(C.SERVE_STREAM_ENABLED, True)
+        s1.conf.set(C.SERVE_STREAM_MAX_BYTES, 1 << 30)  # one wave
+        r_stream = _join(s1, orders, items)
+        s1.conf.set(C.SERVE_STREAM_ENABLED, False)
+        assert r_stream.equals(_join(s1, orders, items))
+
+    def test_mmap_reads_identical(self, s1, tmp_path):
+        """Streaming over memory-mapped parquet reads
+        (``hyperspace.io.mmap.enabled``) changes the buffers' backing,
+        never the bytes."""
+        idir, odir = _tables(tmp_path)
+        _, items, orders = _indexed_session(s1, idir, odir)
+        s1.conf.set(C.SERVE_STREAM_ENABLED, True)
+        s1.conf.set(C.SERVE_STREAM_MAX_BYTES, 64_000)
+        s1.conf.set(C.IO_MMAP_ENABLED, True)
+        r_mmap = _join(s1, orders, items)
+        s1.conf.set(C.IO_MMAP_ENABLED, False)
+        s1.conf.set(C.SERVE_STREAM_ENABLED, False)
+        assert r_mmap.equals(_join(s1, orders, items))
+
+    def test_string_key_join_identical(self, s1, tmp_path):
+        """String JOIN keys force the murmur-collision re-verify leg on
+        every wave."""
+        rng = np.random.default_rng(7)
+        idir, odir = tmp_path / "si", tmp_path / "so"
+        idir.mkdir()
+        odir.mkdir()
+        keys = [f"user-{i}" for i in range(500)]
+        left = pa.table(
+            {
+                "name": pa.array(rng.choice(keys, 20_000)),
+                "v": rng.integers(0, 100, 20_000).astype(np.int64),
+            }
+        )
+        right = pa.table(
+            {"uname": pa.array(keys), "score": rng.normal(0, 1, len(keys))}
+        )
+        for i in range(2):
+            pq.write_table(
+                left.slice(i * 10_000, 10_000), str(idir / f"p{i}.parquet")
+            )
+            pq.write_table(
+                right.slice(i * 250, 250), str(odir / f"p{i}.parquet")
+            )
+        hs = Hyperspace(s1)
+        ldf, rdf = s1.read.parquet(str(idir)), s1.read.parquet(str(odir))
+        hs.create_index(ldf, CoveringIndexConfig("si", ["name"], ["v"]))
+        hs.create_index(rdf, CoveringIndexConfig("so", ["uname"], ["score"]))
+        s1.enable_hyperspace()
+
+        def q():
+            return (
+                ldf.join(rdf, on=ldf["name"] == rdf["uname"])
+                .select("name", "v", "score")
+                .collect()
+            )
+
+        s1.conf.set(C.SERVE_STREAM_ENABLED, True)
+        s1.conf.set(C.SERVE_STREAM_MAX_BYTES, 64_000)
+        r_stream = q()
+        s1.conf.set(C.SERVE_STREAM_ENABLED, False)
+        assert r_stream.equals(q())
+
+    def test_hybrid_append_identical(self, s1, tmp_path):
+        """Appended delta files (hybrid scan) merge into each wave's
+        bucket exactly as the materializing Union path merges them."""
+        idir, odir = _tables(tmp_path)
+        _, items, orders = _indexed_session(s1, idir, odir)
+        rng = np.random.default_rng(3)
+        extra = pa.table(
+            {
+                "k": rng.integers(0, 5_000, 3_000).astype(np.int64),
+                "q": np.full(3_000, 7, dtype=np.int64),
+                "price": np.full(3_000, 1.0),
+                "tag": pa.array(np.full(3_000, "omega")),
+            }
+        )
+        pq.write_table(extra, idir + "/appended.parquet")
+        s1.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, True)
+        s1.index_manager.clear_cache()
+        items2 = s1.read.parquet(idir)
+        s1.conf.set(C.SERVE_STREAM_ENABLED, True)
+        s1.conf.set(C.SERVE_STREAM_MAX_BYTES, 64_000)
+        r_stream = _join(s1, orders, items2)
+        s1.conf.set(C.SERVE_STREAM_ENABLED, False)
+        r_mat = _join(s1, orders, items2)
+        assert r_stream.equals(r_mat)
+        assert "omega" in set(r_stream.column("tag").to_pylist())
+
+    def test_delete_compensation_falls_back_and_matches(self, s1, tmp_path):
+        """Lineage delete compensation (NOT-IN over deleted files)
+        breaks the streamable shape: the probe must decline and the
+        fallback must serve the right answer — never a wrong one,
+        never a crash."""
+        idir, odir = _tables(tmp_path)
+        s1.conf.set(C.INDEX_LINEAGE_ENABLED, True)
+        _, items, orders = _indexed_session(s1, idir, odir)
+        os.unlink(idir + "/p3.parquet")
+        s1.conf.set(C.INDEX_HYBRID_SCAN_ENABLED, True)
+        s1.conf.set(C.INDEX_HYBRID_SCAN_MAX_DELETED_RATIO, 1.0)
+        s1.index_manager.clear_cache()
+        items2 = s1.read.parquet(idir)
+        s1.conf.set(C.SERVE_STREAM_ENABLED, True)
+        s1.conf.set(C.SERVE_STREAM_MAX_BYTES, 64_000)
+        r_stream = _join(s1, orders, items2)
+        s1.conf.set(C.SERVE_STREAM_ENABLED, False)
+        assert r_stream.equals(_join(s1, orders, items2))
+
+
+class TestStreamWaves:
+    def test_wave_telemetry_and_stage_span(self, s1, tmp_path):
+        """A small budget must pack many waves, the bucket count must
+        cover every common bucket exactly once, and the stream_wave
+        stage must land in the serve breakdown (the taxonomy the
+        querylog and bench gates key on)."""
+        from hyperspace_tpu.execution import executor as ex
+        from hyperspace_tpu.execution.join_exec import last_serve_breakdown
+
+        idir, odir = _tables(tmp_path)
+        _, items, orders = _indexed_session(s1, idir, odir)
+        s1.conf.set(C.SERVE_STREAM_ENABLED, True)
+        s1.conf.set(C.SERVE_STREAM_MAX_BYTES, 64_000)
+        small = _join(s1, orders, items)
+        many = dict(ex.last_stream_stats)
+        bd = dict(last_serve_breakdown)
+        s1.conf.set(C.SERVE_STREAM_MAX_BYTES, 1 << 30)
+        big = _join(s1, orders, items)
+        one = dict(ex.last_stream_stats)
+        assert small.equals(big)
+        assert many["stream_waves"] > one["stream_waves"] == 1
+        # waves partition the common buckets: same total either way
+        assert many["stream_buckets"] == one["stream_buckets"]
+        assert bd.get("stream_wave", 0) > 0, bd
+
+    def test_oversized_bucket_runs_alone(self, s1, tmp_path):
+        """A budget smaller than every bucket degenerates to one bucket
+        per wave — correctness never depends on the estimate."""
+        from hyperspace_tpu.execution import executor as ex
+
+        idir, odir = _tables(tmp_path)
+        _, items, orders = _indexed_session(s1, idir, odir)
+        s1.conf.set(C.SERVE_STREAM_ENABLED, True)
+        s1.conf.set(C.SERVE_STREAM_MAX_BYTES, 1)
+        r = _join(s1, orders, items)
+        stats = dict(ex.last_stream_stats)
+        assert stats["stream_waves"] == stats["stream_buckets"]
+        s1.conf.set(C.SERVE_STREAM_ENABLED, False)
+        assert r.equals(_join(s1, orders, items))
+
+
+class TestPrepareContiguousUnit:
+    """prepare_join_side_contiguous vs prepare_join_side over the same
+    rows — every PreparedJoinSide field bit-identical. The contiguous
+    twin is the streaming wave's zero-concat prepare: its input batch IS
+    the concatenation the per-bucket path would have built."""
+
+    def _bucketed(self, rng, sorted_keys, with_nulls=True):
+        batches = {}
+        for b in range(5):
+            n = int(rng.integers(1, 2_000))
+            keys = rng.integers(-50, 50, n).astype(np.int64)
+            if sorted_keys:
+                keys = np.sort(keys)
+            mask = rng.random(n) < (0.05 if with_nulls else 0.0)
+            arr = pa.array(
+                np.where(mask, 0, keys), mask=mask, type=pa.int64()
+            )
+            tags = pa.array(rng.choice(["x", "y", "z"], n))
+            batches[b] = ColumnarBatch.from_arrow(
+                pa.table({"k": arr, "tag": tags})
+            )
+        return batches
+
+    @pytest.mark.parametrize("sorted_keys", [True, False])
+    @pytest.mark.parametrize("with_nulls", [True, False])
+    def test_fields_identical(self, sorted_keys, with_nulls):
+        from hyperspace_tpu.execution.join_exec import (
+            prepare_join_side,
+            prepare_join_side_contiguous,
+        )
+
+        rng = np.random.default_rng(13)
+        batches = self._bucketed(rng, sorted_keys, with_nulls)
+        seq = prepare_join_side(batches, ["k"])
+        order = sorted(batches)
+        contig = prepare_join_side_contiguous(
+            ColumnarBatch.concat([batches[b] for b in order]),
+            tuple(order),
+            [batches[b].num_rows for b in order],
+            ["k"],
+        )
+        assert contig.buckets == seq.buckets
+        np.testing.assert_array_equal(contig.sizes, seq.sizes)
+        np.testing.assert_array_equal(contig.offs, seq.offs)
+        np.testing.assert_array_equal(contig.reps, seq.reps)
+        np.testing.assert_array_equal(contig.combined, seq.combined)
+        assert (contig.nulls is None) == (seq.nulls is None)
+        if contig.nulls is not None:
+            np.testing.assert_array_equal(contig.nulls, seq.nulls)
+        assert contig.sorted_buckets == seq.sorted_buckets
+        assert contig.batch.to_arrow().equals(seq.batch.to_arrow())
+
+    def test_empty_wave_returns_none(self):
+        from hyperspace_tpu.execution.join_exec import (
+            prepare_join_side_contiguous,
+        )
+
+        empty = ColumnarBatch.from_arrow(
+            pa.table({"k": pa.array([], type=pa.int64())})
+        )
+        assert prepare_join_side_contiguous(empty, (), [], ["k"]) is None
