@@ -623,6 +623,92 @@ def main() -> None:
             f"{obs_rows_written} querylog rows"
         )
 
+        # --- advisor closed-loop rung (hyperspace_tpu/advisor/,
+        # docs/advisor.md): a canned skewed workload over a dedicated
+        # lake — record it in query-log format, replay for a baseline,
+        # run profile → what-if recommend → budgeted apply, replay the
+        # SAME workload again, then a second advise() pass. The gates
+        # bench_smoke.sh asserts: the top create recommendation indexes
+        # the workload's filter key (the bench-fastest index for a point
+        # lookup), it applies under budget, the post-apply pass emits
+        # ZERO create recommendations (convergence), and replay QPS
+        # stays within tolerance of the baseline (the index must never
+        # fall off a cliff, even where brute scans win on tiny rows).
+        from hyperspace_tpu.advisor import advise as _advise
+        from hyperspace_tpu.advisor import (
+            apply_recommendations as _advisor_apply,
+        )
+        from hyperspace_tpu.testing import replay as _replay
+
+        adv_lake = os.path.join(tmp, "advisor_lake")
+        os.makedirs(adv_lake)
+        adv_rows = min(n_items, 2_000_000)
+        adv_files = 8
+        rng = np.random.default_rng(29)
+        per = max(1, adv_rows // adv_files)
+        for i in range(adv_files):
+            pq.write_table(
+                pa.table(
+                    {
+                        "key": rng.integers(0, 1000, per),
+                        "ts": np.arange(i * per, (i + 1) * per, dtype=np.int64),
+                        "payload": rng.integers(0, 1 << 30, per),
+                    }
+                ),
+                os.path.join(adv_lake, f"part-{i:03d}.parquet"),
+            )
+        adv_records = _replay.skewed_keys(
+            [adv_lake],
+            "key",
+            list(range(0, 1000, 37)),
+            24,
+            project=["key", "payload"],
+        )
+        adv_obs_dir = os.path.join(tmp, "advisor_obs")
+        _replay.record_workload(adv_records, adv_obs_dir)
+        adv_base = _replay.replay_records(session, adv_records)
+        assert adv_base.completed == len(adv_records), adv_base.to_dict()
+        adv_report = _advise(session, directory=adv_obs_dir)
+        adv_creates = [
+            r for r in adv_report.recommendations if r.kind == "create"
+        ]
+        assert adv_creates, "skewed workload must motivate an index"
+        assert adv_creates[0].indexed_columns[0] == "key", adv_creates[0]
+        adv_summary = _advisor_apply(session, adv_creates, force=True)
+        assert adv_summary["applied"] >= 1, adv_summary
+        adv_after = _replay.replay_records(session, adv_records)
+        assert adv_after.completed == len(adv_records), adv_after.to_dict()
+        adv_second = _advise(session, directory=adv_obs_dir)
+        adv_creates_after = [
+            r for r in adv_second.recommendations if r.kind == "create"
+        ]
+        assert not adv_creates_after, [r.to_dict() for r in adv_creates_after]
+        adv_qps_ratio = adv_after.qps / max(adv_base.qps, 1e-9)
+        assert 0.2 <= adv_qps_ratio <= 5.0, (
+            adv_base.to_dict(), adv_after.to_dict(),
+        )
+        advisor_rung = {
+            "records": len(adv_records),
+            "baseline_p50_ms": round(adv_base.p50_s * 1e3, 2),
+            "after_p50_ms": round(adv_after.p50_s * 1e3, 2),
+            "baseline_qps": round(adv_base.qps, 1),
+            "after_qps": round(adv_after.qps, 1),
+            "qps_ratio": round(adv_qps_ratio, 3),
+            "recommended": [r.index_name for r in adv_creates],
+            "top_indexed_columns": list(adv_creates[0].indexed_columns),
+            "applied": adv_summary["applied"],
+            "creates_after_apply": len(adv_creates_after),
+        }
+        log(
+            f"advisor loop: {len(adv_creates)} rec(s) "
+            f"({adv_creates[0].index_name} on "
+            f"{','.join(adv_creates[0].indexed_columns)}), applied "
+            f"{adv_summary['applied']}, p50 {advisor_rung['baseline_p50_ms']}"
+            f"ms -> {advisor_rung['after_p50_ms']}ms, qps ratio "
+            f"{advisor_rung['qps_ratio']}, converged="
+            f"{not adv_creates_after}"
+        )
+
         # --- fault-injection rung (testing/faults.py): one serve per
         # injection point x {transient, persistent}, each differential
         # against the fault-free result — the bench-level witness that
@@ -1490,6 +1576,7 @@ def main() -> None:
                     ),
                     "serve_concurrency": serve_concurrency,
                     "serve_obs": serve_obs,
+                    "advisor": advisor_rung,
                     "fleet_ladder": fleet_ladder,
                     "fleet_chaos": fleet_chaos,
                     "fleet_vs_64client_qps": round(

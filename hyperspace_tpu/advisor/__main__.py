@@ -1,0 +1,7 @@
+"""``python -m hyperspace_tpu.advisor`` entry point."""
+
+import sys
+
+from hyperspace_tpu.advisor.cli import main
+
+sys.exit(main())

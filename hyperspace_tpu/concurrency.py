@@ -233,6 +233,13 @@ SHARED_STATE: Dict[str, Tuple[str, str, str]] = {
         "approximate-serve telemetry of the most recent estimate, "
         "published as one rebind of a freshly-built dict",
     ),
+    "hyperspace_tpu.testing.replay.last_replay_stats": (
+        "",
+        "rebind-only",
+        "last completed replay's summary dict published whole in one "
+        "rebind; concurrent replays interleave snapshots, never torn "
+        "ones",
+    ),
     # -- observability plane (hyperspace_tpu/obs/) ---------------------------
     "hyperspace_tpu.obs.trace._enabled": (
         "",

@@ -439,6 +439,58 @@ class Config:
     def obs_eventlog_path(self) -> str:
         return self.get_str(C.OBS_EVENTLOG_PATH, C.OBS_EVENTLOG_PATH_DEFAULT)
 
+    @property
+    def obs_querylog_record_plans(self) -> bool:
+        """Opt-in replayable plan specs in querylog records — specs
+        carry literals, unlike the scrubbed predicate shape."""
+        return self.get_bool(
+            C.OBS_QUERYLOG_RECORD_PLANS, C.OBS_QUERYLOG_RECORD_PLANS_DEFAULT
+        )
+
+    # -- workload advisor (hyperspace_tpu/advisor/) --------------------------
+    @property
+    def advisor_profile_max_shapes(self) -> int:
+        return max(
+            1,
+            self.get_int(
+                C.ADVISOR_PROFILE_MAX_SHAPES,
+                C.ADVISOR_PROFILE_MAX_SHAPES_DEFAULT,
+            ),
+        )
+
+    @property
+    def advisor_max_candidates(self) -> int:
+        return max(
+            1,
+            self.get_int(
+                C.ADVISOR_MAX_CANDIDATES, C.ADVISOR_MAX_CANDIDATES_DEFAULT
+            ),
+        )
+
+    @property
+    def advisor_apply_enabled(self) -> bool:
+        return self.get_bool(
+            C.ADVISOR_APPLY_ENABLED, C.ADVISOR_APPLY_ENABLED_DEFAULT
+        )
+
+    @property
+    def advisor_apply_max_bytes(self) -> int:
+        return max(
+            1,
+            self.get_int(
+                C.ADVISOR_APPLY_MAX_BYTES, C.ADVISOR_APPLY_MAX_BYTES_DEFAULT
+            ),
+        )
+
+    @property
+    def advisor_apply_max_seconds(self) -> float:
+        return max(
+            0.0,
+            self.get_float(
+                C.ADVISOR_APPLY_MAX_SECONDS, C.ADVISOR_APPLY_MAX_SECONDS_DEFAULT
+            ),
+        )
+
     # -- replicated serve fleet (serve/fleet.py, serve/bus.py) ---------------
     @property
     def fleet_enabled(self) -> bool:

@@ -513,9 +513,44 @@ OBS_TRACE_RETAIN_DEFAULT = 256
 OBS_EVENTLOG_PATH = "hyperspace.obs.eventlog.path"
 OBS_EVENTLOG_PATH_DEFAULT = ""
 
+# Opt-in replayable plan specs in the query log (obs/planspec.py): each
+# record additionally carries a re-executable "replay" plan spec.
+# Specs retain predicate LITERALS (unlike the scrubbed predicate
+# shape), so this stays off unless the operator wants the advisor's
+# what-if scoring and the replay harness (testing/replay.py) to work
+# straight from production logs.
+OBS_QUERYLOG_RECORD_PLANS = "hyperspace.obs.querylog.recordPlans"
+OBS_QUERYLOG_RECORD_PLANS_DEFAULT = False
+
 # Observability sidecar directory under the lake root (underscore-
 # prefixed: invisible to data scans, like the quarantine/pins dirs).
 HYPERSPACE_OBS_DIR = "_hyperspace_obs"
+
+# -- workload advisor (hyperspace_tpu/advisor/, docs/advisor.md) --------------
+# Workload-profile bound: the query-log aggregator groups records by
+# literal-scrubbed predicate shape and keeps at most this many shape
+# groups resident (further shapes fold into an overflow counter) — the
+# profile is O(maxShapes), never O(records), whatever the log size
+# (ALLOC_SITES const-bounded contract).
+ADVISOR_PROFILE_MAX_SHAPES = "hyperspace.advisor.profile.maxShapes"
+ADVISOR_PROFILE_MAX_SHAPES_DEFAULT = 256
+
+# What-if search bound: at most this many candidate indexes are
+# enumerated from the hot shapes and scored against the recorded
+# workload per advise() pass (hottest shapes first, overflow logged).
+ADVISOR_MAX_CANDIDATES = "hyperspace.advisor.maxCandidates"
+ADVISOR_MAX_CANDIDATES_DEFAULT = 32
+
+# Opt-in budgeted apply: advisor.apply() executes top recommendations
+# through the lifecycle actions (lease-stamped like any maintenance,
+# so fleet serve traffic sees the PR 10 protections) until either
+# budget is exhausted. Off = advise-only, nothing touches the lake.
+ADVISOR_APPLY_ENABLED = "hyperspace.advisor.apply.enabled"
+ADVISOR_APPLY_ENABLED_DEFAULT = False
+ADVISOR_APPLY_MAX_BYTES = "hyperspace.advisor.apply.maxBytes"
+ADVISOR_APPLY_MAX_BYTES_DEFAULT = 1 << 30
+ADVISOR_APPLY_MAX_SECONDS = "hyperspace.advisor.apply.maxSeconds"
+ADVISOR_APPLY_MAX_SECONDS_DEFAULT = 300.0
 
 # -- replicated serve fleet (serve/fleet.py, serve/bus.py) -------------------
 # Master switch for fleet mode: N ServeFrontend processes over ONE index

@@ -53,6 +53,7 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 
+from hyperspace_tpu.obs import trace as obs_trace
 from hyperspace_tpu.plan import expressions as E
 
 _log = logging.getLogger("hyperspace_tpu.zonemaps")
@@ -1111,6 +1112,10 @@ def prune_scan_relation(scan, cond: E.Expr, cache=None):
     # opaque files (unreadable stats) are never narrowed
     keep |= zd.opaque[zd.rg_file]
     stats["row_groups_kept"] = int(keep.sum())
+    # per-execution attribution: the calling query's root span gets
+    # exactly this evaluation's delta, so concurrent queries never read
+    # each other's pruning out of the module-global last_prune_stats
+    obs_trace.accumulate("rows_pruned", n - stats["row_groups_kept"])
     if bool(keep.all()):
         stats["files_kept"] = len(rel.files)
         stats["row_groups_kept"] = n

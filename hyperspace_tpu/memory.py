@@ -319,6 +319,18 @@ ALLOC_SITES: Dict[str, Tuple[str, str, str]] = {
         "re-reads the previous sketch table — one O(1) row per source "
         "file, file-count- not row-proportional",
     ),
+    # -- workload advisor (advisor/) -----------------------------------------
+    # pure-Python dict/list growth, invisible to the checker's
+    # numpy/pyarrow allocation model; declared anyway so the residency
+    # witness measures it  # hslint: disable=HS1003
+    "hyperspace_tpu.advisor.profile.build_profile": (
+        "maintenance",
+        "const-bounded",
+        "folds a query-log stream into at most advisor.profile."
+        "maxShapes shape groups (overflow counted, not stored), each "
+        "capped at _DURATION_SAMPLES duration samples — O(maxShapes), "
+        "never O(records)",
+    ),
     # -- io: generic scan plumbing -------------------------------------------
     "hyperspace_tpu.io.scan.read_relation_files": (
         "serve",

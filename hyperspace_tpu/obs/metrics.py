@@ -409,6 +409,10 @@ querylog_rotations_total = registry.counter(
 querylog_errors_total = registry.counter(
     "hs_querylog_errors_total", "query-log append/rotate failures (dropped)"
 )
+querylog_skipped_total = registry.counter(
+    "hs_obs_querylog_skipped_total",
+    "query-log records skipped by readers (unknown/newer schema_v)",
+)
 
 
 # ---------------------------------------------------------------------------

@@ -24,10 +24,16 @@ Record schema (one JSON object per line; schema_v bumps on change)::
     stages           {stage: busy_seconds} from the root span's children
                      (mirrors last_serve_breakdown keys)
     rows_returned    result rows
-    rows_pruned      row groups pruned by the range plane (best-effort
-                     snapshot of zonemaps.last_prune_stats — concurrent
-                     queries blur attribution, same caveat as the
-                     breakdowns)
+    rows_pruned      row groups pruned by the range plane during THIS
+                     execution — the pruning pass accumulates its delta
+                     onto the query's root span (obs/trace.accumulate),
+                     so concurrent queries never cross-attribute (the
+                     old last_prune_stats module read blurred exactly
+                     that way)
+    replay           optional re-executable plan spec (obs/planspec.py)
+                     — present only when the operator opted into
+                     ``hyperspace.obs.querylog.recordPlans`` (specs
+                     carry literals, unlike ``predicate``)
     cache_hits       ServeCache hit counters delta is NOT tracked here;
                      the registry's cache view carries totals
     retries/degraded/deduped_into  per-query fault-path events
@@ -222,6 +228,25 @@ def read_records(directory: str) -> List[Dict]:
     out: List[Dict] = []
     for name in names:
         out.extend(_metrics.read_jsonl(os.path.join(directory, name)))
+    return out
+
+
+def read_valid_records(directory: str) -> List[Dict]:
+    """:func:`read_records` plus the forward-compat filter every
+    CONSUMER (advisor, replay, bench gates) must apply: records whose
+    ``schema_v`` is missing, non-int, or NEWER than this reader
+    understands are skipped and counted
+    (``hs_obs_querylog_skipped_total``), never raised on — a fleet mid
+    rolling-upgrade has old readers and new writers sharing one
+    directory, and an old advisor choking on a new record shape would
+    turn a diagnostics plane into an outage."""
+    out: List[Dict] = []
+    for rec in read_records(directory):
+        v = rec.get("schema_v")
+        if not isinstance(v, int) or isinstance(v, bool) or v > SCHEMA_V:
+            _metrics.querylog_skipped_total.inc()
+            continue
+        out.append(rec)
     return out
 
 

@@ -80,11 +80,20 @@ BUILD_STAGES = (
     "log_commit",
 )
 
+#: advisor-side stage spans (advisor/: query-log mining and what-if
+#: scoring under one "advisor.run" root — docs/advisor.md)
+ADVISOR_STAGES = (
+    "advisor.scan",
+    "advisor.score",
+)
+
 #: root span names (constant ones; action roots are "action.<Class>")
-ROOT_NAMES = ("serve.query",)
+ROOT_NAMES = ("serve.query", "advisor.run")
 
 #: the full constant-name vocabulary HS902 checks against
-STAGE_NAMES = tuple(sorted(set(SERVE_STAGES) | set(BUILD_STAGES)))
+STAGE_NAMES = tuple(
+    sorted(set(SERVE_STAGES) | set(BUILD_STAGES) | set(ADVISOR_STAGES))
+)
 
 OBS_SITES: Dict[str, Tuple[str, str]] = {
     # -- serve plane ---------------------------------------------------------
@@ -180,5 +189,35 @@ OBS_SITES: Dict[str, Tuple[str, str]] = {
         "span",
         "the coordinator-side log_commit stage on multi-process jobs "
         "(the same seam, behind the rendezvous protocol)",
+    ),
+    # -- workload advisor (advisor/, docs/advisor.md) ------------------------
+    "hyperspace_tpu.advisor.recommend.advise": (
+        "span",
+        "the advisor.run ROOT span — one trace per advise() pass, so "
+        "mining + what-if time is explainable in the same plane it "
+        "consumes",
+    ),
+    "hyperspace_tpu.advisor.profile.build_profile": (
+        "span",
+        "advisor.scan stage: query-log union + shape aggregation time, "
+        "separable from scoring (a huge log must be visible as a scan "
+        "cost, not a mystery)",
+    ),
+    "hyperspace_tpu.advisor.whatif.score_workload": (
+        "span",
+        "advisor.score stage: one span per candidate's workload pass — "
+        "what-if cost scales with candidates x shapes and must be "
+        "attributable",
+    ),
+    "hyperspace_tpu.advisor.profile": (
+        "metric",
+        "advisor health counters (profiles built, shape-cap overflows) "
+        "— the convergence loop's own telemetry rides the registry",
+    ),
+    "hyperspace_tpu.testing.replay": (
+        "metric",
+        "replay harness instruments (queries replayed/skipped/failed) — "
+        "the bench replay gate asserts on these, same plane as the "
+        "querylog counters",
     ),
 }

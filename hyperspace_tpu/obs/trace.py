@@ -342,6 +342,26 @@ def event(name: str, **attrs) -> None:
         cur.add_event(name, **attrs)
 
 
+def accumulate(key: str, value) -> None:
+    """Add ``value`` into the ROOT span's ``attrs[key]`` (numeric
+    accumulator, taken under the record lock — hooks fire from
+    arbitrary pool threads). This is how per-execution counters that
+    are produced deep inside the engine (e.g. zone-map pruning's
+    rows-pruned count) attribute to the query that caused them instead
+    of to a process-global last-writer cell: each execution's root
+    carries exactly its own deltas, so concurrent queries never
+    cross-attribute. Dropped when tracing is off or no trace is
+    active."""
+    if not _enabled:
+        return
+    cur = _current.get()
+    if cur is None:
+        return
+    root_span = cur.root
+    with _rec_lock:
+        root_span.attrs[key] = root_span.attrs.get(key, 0) + value
+
+
 def current() -> Optional[Span]:
     if not _enabled:
         return None

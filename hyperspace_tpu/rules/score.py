@@ -54,9 +54,20 @@ class ScoreBasedIndexPlanOptimizer:
         self.rules = _all_rules()
 
     def apply(self, plan: LogicalPlan, candidates: CandidateMap) -> LogicalPlan:
-        self._memo: Dict[int, Tuple[LogicalPlan, int]] = {}
-        best, _score = self._rec_apply(plan, candidates)
+        best, _score = self.apply_with_score(plan, candidates)
         return best
+
+    def apply_with_score(
+        self, plan: LogicalPlan, candidates: CandidateMap
+    ) -> Tuple[LogicalPlan, int]:
+        """The search result WITH its winning score — the what-if
+        advisor's comparison primitive (``advisor/whatif.py``): score a
+        plan against the active candidate set, then again with a
+        hypothetical entry injected; the score delta is the candidate's
+        predicted usefulness on that plan, by the exact machinery serve
+        rewrites run through (never a parallel cost model)."""
+        self._memo: Dict[int, Tuple[LogicalPlan, int]] = {}
+        return self._rec_apply(plan, candidates)
 
     def _rec_apply(
         self, plan: LogicalPlan, candidates: CandidateMap

@@ -66,6 +66,7 @@ from hyperspace_tpu.exceptions import (
 )
 from hyperspace_tpu.metadata import recovery
 from hyperspace_tpu.obs import metrics as obs_metrics
+from hyperspace_tpu.obs import planspec as obs_planspec
 from hyperspace_tpu.obs import querylog as obs_querylog
 from hyperspace_tpu.obs import trace as obs_trace
 from hyperspace_tpu.plan.nodes import LogicalPlan
@@ -298,6 +299,11 @@ class ServeFrontend:
                 hashlib.sha256(repr(fp).encode("utf-8")).hexdigest()[:16],
             )
             root.set("predicate", obs_querylog.predicate_shape(plan))
+            if self._session.conf.obs_querylog_record_plans:
+                # opt-in: specs carry literals (obs/planspec.py doctrine)
+                spec = obs_planspec.to_spec(plan)
+                if spec is not None:
+                    root.set("replay", spec)
         try:
             with self._lock:
                 existing = self._inflight.get(fp)
@@ -504,27 +510,33 @@ class ServeFrontend:
         best-effort — an unwritable sidecar never fails the query)."""
         if self._querylog is None:
             return
-        self._querylog.append(
-            {
-                "ts_ms": root.start_ms,
-                "trace_id": root.trace_id,
-                "fingerprint": root.attrs.get("fingerprint", ""),
-                "predicate": root.attrs.get("predicate", ""),
-                "slo_class": root.attrs.get("slo_class"),
-                "indexes": root.attrs.get("indexes", []),
-                "rule": root.attrs.get("rule"),
-                "duration_s": time.perf_counter() - root._t0,
-                "stages": {
-                    k: round(v, 6) for k, v in root.stage_seconds().items()
-                },
-                "rows_returned": root.attrs.get("rows_returned", 0),
-                "events": [
-                    {k: v for k, v in ev.items()}
-                    for ev in root.events[-32:]
-                ],
-                "status": root.attrs.get("status", "ok"),
-            }
-        )
+        rec = {
+            "ts_ms": root.start_ms,
+            "trace_id": root.trace_id,
+            "fingerprint": root.attrs.get("fingerprint", ""),
+            "predicate": root.attrs.get("predicate", ""),
+            "slo_class": root.attrs.get("slo_class"),
+            "indexes": root.attrs.get("indexes", []),
+            "rule": root.attrs.get("rule"),
+            "duration_s": time.perf_counter() - root._t0,
+            "stages": {
+                k: round(v, 6) for k, v in root.stage_seconds().items()
+            },
+            "rows_returned": root.attrs.get("rows_returned", 0),
+            # per-execution delta accumulated by the pruning pass onto
+            # THIS root (obs_trace.accumulate) — never a module-global
+            # read that a concurrent query could have overwritten
+            "rows_pruned": int(root.attrs.get("rows_pruned", 0)),
+            "events": [
+                {k: v for k, v in ev.items()}
+                for ev in root.events[-32:]
+            ],
+            "status": root.attrs.get("status", "ok"),
+        }
+        spec = root.attrs.get("replay")
+        if spec is not None:
+            rec["replay"] = spec
+        self._querylog.append(rec)
 
     def _record(self, t_start: float) -> None:
         dt = time.perf_counter() - t_start

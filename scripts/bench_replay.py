@@ -1,0 +1,141 @@
+"""Workload-replay bench — the advisor loop timed end to end.
+
+Builds a throwaway lake, records a canned scenario (query-log format,
+replay specs included), replays it through the serve frontend for a
+BASELINE, runs the advisor (profile -> what-if recommend -> budgeted
+apply), replays the SAME workload again, and runs a second advise()
+pass to witness convergence (zero create recommendations once the
+recommended index exists).
+
+Prints exactly ONE JSON line on stdout (progress to stderr):
+
+    {"scenario": ..., "records": N, "baseline": {qps, p50_s, ...},
+     "after": {...}, "recommended": [names], "applied": N,
+     "recs_after_apply": N, "speedup_p50": x}
+
+Usage:  python scripts/bench_replay.py [scenario]
+        scenario: skewed (default) | storm | rolling | tenants
+Env:    HS_REPLAY_ROWS (default 200_000), HS_REPLAY_QUERIES (default 40),
+        HS_REPLAY_FILES (default 8)
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build_lake(data_dir: str, rows: int, files: int) -> None:
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    per = max(1, rows // files)
+    rng = np.random.default_rng(7)
+    for i in range(files):
+        n = per
+        table = pa.table(
+            {
+                "key": rng.integers(0, 1000, n),
+                "ts": np.arange(i * n, i * n + n, dtype=np.int64),
+                "payload": rng.integers(0, 1 << 30, n),
+            }
+        )
+        pq.write_table(table, os.path.join(data_dir, f"part-{i:03d}.parquet"))
+
+
+def make_scenario(name: str, paths, queries: int):
+    from hyperspace_tpu.testing import replay
+
+    keys = list(range(0, 1000, 37))
+    if name == "storm":
+        return replay.hot_key_storm(
+            paths, "key", 111, keys, queries, project=["key", "payload"]
+        )
+    if name == "rolling":
+        marks = list(range(0, queries * 500, 500))[: max(1, queries // 4)]
+        return replay.rolling_appends(paths, "ts", marks)
+    if name == "tenants":
+        half = queries // 2
+        return replay.tenant_mix(
+            paths, "key", keys,
+            {"interactive": half, "batch": queries - half},
+            project=["key", "payload"],
+        )
+    return replay.skewed_keys(
+        paths, "key", keys, queries, project=["key", "payload"]
+    )
+
+
+def main() -> int:
+    scenario = sys.argv[1] if len(sys.argv) > 1 else "skewed"
+    rows = int(os.environ.get("HS_REPLAY_ROWS", 200_000))
+    queries = int(os.environ.get("HS_REPLAY_QUERIES", 40))
+    files = int(os.environ.get("HS_REPLAY_FILES", 8))
+
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.advisor import advise, apply_recommendations
+    from hyperspace_tpu.session import HyperspaceSession
+    from hyperspace_tpu.testing import replay as replay_mod
+
+    root = tempfile.mkdtemp(prefix="hs_bench_replay_")
+    data_dir = os.path.join(root, "lake")
+    os.makedirs(data_dir)
+    try:
+        log(f"building lake: {rows} rows x {files} files")
+        build_lake(data_dir, rows, files)
+        paths = [data_dir]
+        records = make_scenario(scenario, paths, queries)
+        obs_dir = os.path.join(root, "obs")
+        replay_mod.record_workload(records, obs_dir)
+
+        session = HyperspaceSession()
+        session.conf.set(C.INDEX_SYSTEM_PATH, os.path.join(root, "indexes"))
+        session.enable_hyperspace()
+
+        log(f"baseline replay: {len(records)} records")
+        baseline = replay_mod.replay_records(session, records)
+
+        log("advising")
+        report = advise(session, directory=obs_dir)
+        recs = report.recommendations
+        log(f"recommendations: {[r.index_name for r in recs]}")
+        summary = (
+            apply_recommendations(session, recs, force=True)
+            if recs
+            else {"applied": 0}
+        )
+
+        after = replay_mod.replay_records(session, records)
+        report2 = advise(session, directory=obs_dir)
+        creates_after = [
+            r for r in report2.recommendations if r.kind == "create"
+        ]
+        out = {
+            "scenario": scenario,
+            "records": len(records),
+            "baseline": baseline.to_dict(),
+            "after": after.to_dict(),
+            "recommended": [r.index_name for r in recs],
+            "applied": summary["applied"],
+            "recs_after_apply": len(creates_after),
+            "speedup_p50": round(
+                baseline.p50_s / after.p50_s, 3
+            ) if after.p50_s > 0 else 0.0,
+        }
+        print(json.dumps(out), flush=True)
+        return 0
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

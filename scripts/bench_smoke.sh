@@ -143,6 +143,22 @@ assert so["roots"] == so["executions"], so
 assert so["querylog_rows"] == so["executions"], so
 assert so["p50_on_ms"] > 0 and so["p50_off_ms"] > 0, so
 print("bench_smoke: obs plane ok:", so, file=sys.stderr)
+# the advisor closed loop (docs/advisor.md): the canned skewed replay
+# must have produced create recommendation(s) whose top pick indexes
+# the workload filter key (the bench-fastest index for a point
+# lookup), the budgeted apply must have executed it, the second
+# advise() pass must converge to ZERO create recommendations, and the
+# post-apply replay must hold QPS within tolerance of the baseline
+# (tiny smoke rows can favor brute scans; the index must still never
+# fall off a cliff)
+adv = d["advisor"]
+assert adv["recommended"], adv
+assert adv["top_indexed_columns"][0] == "key", adv
+assert adv["applied"] >= 1, adv
+assert adv["creates_after_apply"] == 0, adv
+assert 0.2 <= adv["qps_ratio"] <= 5.0, adv
+assert adv["baseline_p50_ms"] > 0 and adv["after_p50_ms"] > 0, adv
+print("bench_smoke: advisor loop ok:", adv, file=sys.stderr)
 fi = d["fault_injection"]
 for point in ("parquet_read", "kernel_dispatch", "log_read", "cache_insert"):
     assert fi["fired"].get(point, 0) >= 1, (point, fi)

@@ -1,0 +1,375 @@
+"""Workload advisor (hyperspace_tpu/advisor/, docs/advisor.md).
+
+Five legs, mirroring the ISSUE's acceptance criteria:
+
+* plan specs round-trip: record a plan, rebuild it against the
+  session, serve the SAME answer;
+* the profile is bounded (maxShapes cap folds into overflow, never
+  grows the dict) and per-execution ``rows_pruned`` attribution holds
+  (``trace.accumulate`` is root-scoped);
+* candidate enumeration mirrors the consuming rules (filter / join /
+  aggregate shapes) and what-if scoring uses the REAL rule chain — a
+  candidate twin of an existing index gains zero;
+* apply is gated, budgeted, and failure-isolated;
+* the closed loop converges end to end: skewed workload -> profile ->
+  recommend the known-best covering index -> apply under budget ->
+  replayed p50 improves -> second pass recommends nothing.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.advisor import (
+    advise,
+    apply_recommendations,
+    build_profile,
+    hypothetical_entry,
+    score_workload,
+)
+from hyperspace_tpu.advisor import recommend as rec_mod
+from hyperspace_tpu.constants import States
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.indexes.covering import CoveringIndexConfig
+from hyperspace_tpu.obs import planspec, trace
+from hyperspace_tpu.testing import replay
+
+
+@pytest.fixture(autouse=True)
+def _obs_isolation():
+    trace.reset()
+    yield
+    trace.set_enabled(False)
+    trace.reset()
+
+
+def _lake(tmp_path, rows=40_000, files=4, name="lake"):
+    d = tmp_path / name
+    d.mkdir()
+    rng = np.random.default_rng(5)
+    per = rows // files
+    for i in range(files):
+        pq.write_table(
+            pa.table(
+                {
+                    "key": rng.integers(0, 1000, per),
+                    "ts": np.arange(i * per, (i + 1) * per, dtype=np.int64),
+                    "payload": rng.integers(0, 1 << 30, per),
+                }
+            ),
+            str(d / f"part-{i:03d}.parquet"),
+        )
+    return str(d)
+
+
+class TestPlanSpec:
+    def test_round_trip_serves_same_answer(self, session_factory, tmp_path):
+        s = session_factory(1)
+        data = _lake(tmp_path)
+        df = s.read.parquet(data)
+        q = df.filter(df["key"] == 7).select("key", "payload")
+        spec = planspec.to_spec(q.logical_plan)
+        assert spec is not None and spec["spec_v"] == planspec.SPEC_V
+        from hyperspace_tpu.dataframe import DataFrame
+
+        rebuilt = planspec.from_spec(s, spec)
+        a = DataFrame(s, q.logical_plan).to_arrow()
+        b = DataFrame(s, rebuilt).to_arrow()
+        assert a.num_rows == b.num_rows
+        assert a.column("payload").to_pylist() == b.column("payload").to_pylist()
+
+    def test_unsupported_plan_records_no_spec(self, session_factory, tmp_path):
+        s = session_factory(1)
+        data = _lake(tmp_path)
+        df = s.read.parquet(data)
+        other = s.read.parquet(data)
+        union = df.union(other) if hasattr(df, "union") else None
+        if union is not None:
+            assert planspec.to_spec(union.logical_plan) is None
+
+    def test_unknown_spec_version_raises(self, session_factory):
+        s = session_factory(1)
+        with pytest.raises(HyperspaceException):
+            planspec.from_spec(s, {"op": "scan", "fmt": "parquet",
+                                   "paths": ["/x"], "spec_v": 99})
+
+
+class TestProfile:
+    def test_shape_cap_folds_into_overflow(self):
+        recs = [
+            {"predicate": f"shape{i}", "duration_s": 0.01, "status": "ok",
+             "ts_ms": i}
+            for i in range(10)
+        ]
+        prof = build_profile(recs, max_shapes=4)
+        assert len(prof.shapes) == 4
+        assert prof.overflow_records == 6
+        assert prof.records == 10
+
+    def test_hot_shapes_rank_by_cost_then_count(self):
+        recs = (
+            [{"predicate": "cheap", "duration_s": 0.001, "status": "ok"}] * 5
+            + [{"predicate": "hot", "duration_s": 1.0, "status": "ok"}] * 2
+        )
+        prof = build_profile(recs)
+        assert prof.hot_shapes(1)[0].shape == "hot"
+
+    def test_degrade_retry_and_stage_aggregation(self):
+        rec = {
+            "predicate": "p", "duration_s": 0.5, "status": "failed",
+            "stages": {"scan": 0.1, "prune": 0.02},
+            "events": [{"name": "degrade"}, {"name": "retry"}],
+            "indexes": ["idx1"], "slo_class": "batch", "rows_pruned": 7,
+        }
+        prof = build_profile([rec, dict(rec)])
+        s = prof.shapes["p"]
+        assert s.degrades == 2 and s.retries == 2 and s.failed == 2
+        assert s.stages["scan"] == pytest.approx(0.2)
+        assert s.indexes == {"idx1": 2}
+        assert s.rows_pruned == 14
+
+    def test_accumulate_is_root_scoped(self):
+        """Satellite 1: rows_pruned attributes to the EXECUTING query's
+        root, so two queries pruning different amounts never blur."""
+        trace.set_enabled(True)
+        r1 = trace.root("serve.query")
+        with trace.activate(r1):
+            trace.accumulate("rows_pruned", 5)
+            trace.accumulate("rows_pruned", 2)
+        r1.finish()
+        r2 = trace.root("serve.query")
+        with trace.activate(r2):
+            trace.accumulate("rows_pruned", 3)
+        r2.finish()
+        assert r1.attrs["rows_pruned"] == 7
+        assert r2.attrs["rows_pruned"] == 3
+
+
+class TestWhatIf:
+    def test_candidate_gain_positive_then_zero_once_real(
+        self, session_factory, tmp_path
+    ):
+        s = session_factory(1)
+        data = _lake(tmp_path)
+        df = s.read.parquet(data)
+        plan = df.filter(df["key"] == 3).select("key", "payload").logical_plan
+        cands = rec_mod.enumerate_candidates(plan)
+        assert [c.config.indexed_columns for c in cands] == [["key"]]
+        hypo = hypothetical_entry(s, df, cands[0].config)
+        out = score_workload(s, [(plan, 1.0)], [], hypo)
+        assert out["gain"] > 0 and out["plans_improved"] == 1
+        # build the real twin: the hypothetical stops adding anything
+        Hyperspace(s).create_index(
+            df,
+            CoveringIndexConfig(
+                cands[0].config.index_name,
+                list(cands[0].config.indexed_columns),
+                list(cands[0].config.included_columns),
+            ),
+        )
+        active = s.index_manager.get_indexes([States.ACTIVE])
+        out2 = score_workload(s, [(plan, 1.0)], active, hypo)
+        assert out2["gain"] == 0
+
+    def test_join_and_agg_candidates(self, session_factory, tmp_path):
+        s = session_factory(1)
+        data = _lake(tmp_path)
+        other = tmp_path / "orders"
+        other.mkdir()
+        rng = np.random.default_rng(9)
+        pq.write_table(
+            pa.table(
+                {
+                    "okey": rng.integers(0, 1000, 4_000),
+                    "cost": rng.integers(0, 100, 4_000),
+                }
+            ),
+            str(other / "part-000.parquet"),
+        )
+        left = s.read.parquet(data).select("key", "payload")
+        right = s.read.parquet(str(other))
+        jp = left.join(right, left["key"] == right["okey"]).logical_plan
+        cands = rec_mod.enumerate_candidates(jp)
+        kinds = {tuple(c.config.indexed_columns) for c in cands}
+        assert kinds == {("key",), ("okey",)}  # one per join side
+        from hyperspace_tpu.plan.nodes import AggSpec
+
+        ap = (
+            s.read.parquet(data)
+            .group_by("key")
+            .agg(AggSpec("sum", "payload", "total"))
+            .logical_plan
+        )
+        acands = rec_mod.enumerate_candidates(ap)
+        assert [c.config.indexed_columns for c in acands] == [["key"]]
+
+
+class TestApply:
+    def test_apply_requires_opt_in(self, session_factory, tmp_path):
+        s = session_factory(1)
+        with pytest.raises(HyperspaceException):
+            apply_recommendations(s, [])
+        s.conf.set(C.ADVISOR_APPLY_ENABLED, True)
+        assert apply_recommendations(s, [])["applied"] == 0
+
+    def test_byte_budget_skips_but_later_cheaper_fit(
+        self, session_factory, tmp_path
+    ):
+        s = session_factory(1)
+        data = _lake(tmp_path, rows=4_000, files=2)
+        df = s.read.parquet(data)
+        plan = df.filter(df["key"] == 1).select("key", "payload").logical_plan
+        cand = rec_mod.enumerate_candidates(plan)[0]
+
+        def mk(name, est):
+            return rec_mod.Recommendation(
+                kind="create", index_name=name, index_kind="CoveringIndex",
+                indexed_columns=list(cand.config.indexed_columns),
+                included_columns=list(cand.config.included_columns),
+                source_paths=list(cand.source_paths),
+                estimated_benefit_s=1.0, estimated_build_bytes=est,
+                score_gain=1.0, shapes=[], reason="test",
+            )
+
+        out = apply_recommendations(
+            s, [mk("adv_big", 10_000), mk("adv_small", 10)],
+            max_bytes=100, force=True,
+        )
+        by = {o["index"]: o["outcome"] for o in out["outcomes"]}
+        assert by == {"adv_big": "skipped", "adv_small": "applied"}
+        names = {e.name for e in s.index_manager.get_indexes([States.ACTIVE])}
+        assert "adv_small" in names and "adv_big" not in names
+
+    def test_failures_do_not_abort_the_pass(self, session_factory, tmp_path):
+        s = session_factory(1)
+        bad = rec_mod.Recommendation(
+            kind="refresh", index_name="nope", index_kind="CoveringIndex",
+            indexed_columns=["key"], included_columns=[], source_paths=[],
+            estimated_benefit_s=1.0, estimated_build_bytes=0,
+            score_gain=0.0, shapes=[], reason="test",
+        )
+        out = apply_recommendations(s, [bad, bad], force=True)
+        assert out["failed"] == 2 and out["applied"] == 0
+
+
+class TestReplay:
+    def test_records_without_spec_are_counted_skipped(
+        self, session_factory, tmp_path
+    ):
+        s = session_factory(1)
+        s.enable_hyperspace()
+        data = _lake(tmp_path, rows=4_000, files=2)
+        recs = replay.skewed_keys([data], "key", [1, 2, 3], 4)
+        bare = {k: v for k, v in recs[0].items() if k != "replay"}
+        result = replay.replay_records(s, recs + [bare])
+        assert result.submitted == 4
+        assert result.completed == 4
+        assert result.skipped == 1
+        assert replay.last_replay_stats["completed"] == 4
+
+    def test_slo_classes_flow_to_admission(self, session_factory, tmp_path):
+        s = session_factory(1)
+        s.enable_hyperspace()
+        data = _lake(tmp_path, rows=4_000, files=2)
+        recs = replay.tenant_mix(
+            [data], "key", [1, 2], {"interactive": 3, "batch": 2}
+        )
+        result = replay.replay_records(s, recs)
+        assert result.completed == 5
+        stats = s.serve_frontend.stats()
+        classes = stats.get("classes") or {}
+        if classes:  # fleet class accounting present in this build
+            assert set(classes) >= {"interactive", "batch"}
+
+    def test_preserve_timing_respects_gaps(self, session_factory, tmp_path):
+        import time as _time
+
+        s = session_factory(1)
+        s.enable_hyperspace()
+        data = _lake(tmp_path, rows=4_000, files=2)
+        recs = replay.skewed_keys(
+            [data], "key", [1], 3, start_ts_ms=0, interarrival_ms=120
+        )
+        t0 = _time.perf_counter()
+        replay.replay_records(s, recs, preserve_timing=True)
+        assert _time.perf_counter() - t0 >= 0.24  # two recorded gaps
+
+    def test_record_workload_round_trips_reader(self, tmp_path):
+        from hyperspace_tpu.obs import querylog
+
+        recs = replay.rolling_appends(["/x"], "ts", [1, 2], 2)
+        d = str(tmp_path / "obs")
+        assert replay.record_workload(recs, d) == len(recs)
+        got = querylog.read_valid_records(d)
+        assert len(got) == len(recs)
+        for r in got:
+            assert querylog.validate_record(r) is None
+
+
+class TestConvergence:
+    def test_closed_loop_improves_p50_then_recommends_nothing(
+        self, session_factory, tmp_path
+    ):
+        s = session_factory(1)
+        data = _lake(tmp_path, rows=2_000_000, files=8)
+        keys = list(range(0, 1000, 37))
+        records = replay.skewed_keys(
+            [data], "key", keys, 16, project=["key", "payload"]
+        )
+        obs_dir = str(tmp_path / "obs")
+        replay.record_workload(records, obs_dir)
+        s.enable_hyperspace()
+
+        baseline = replay.replay_records(s, records)
+        assert baseline.completed == len(records)
+
+        report = advise(s, directory=obs_dir)
+        creates = [r for r in report.recommendations if r.kind == "create"]
+        assert creates, "the skewed workload must motivate an index"
+        top = creates[0]
+        assert top.indexed_columns[0] == "key"
+        assert top.index_kind == "CoveringIndex"
+        assert top.estimated_benefit_s > 0
+
+        summary = apply_recommendations(s, creates, force=True)
+        assert summary["applied"] >= 1
+
+        after = replay.replay_records(s, records)
+        assert after.completed == len(records)
+        assert after.p50_s < baseline.p50_s, (
+            baseline.to_dict(), after.to_dict()
+        )
+
+        report2 = advise(s, directory=obs_dir)
+        assert [
+            r for r in report2.recommendations if r.kind == "create"
+        ] == [], "second pass must converge to zero create recommendations"
+
+
+class TestCli:
+    def test_report_and_recommend(self, tmp_path, capsys, session_factory):
+        from hyperspace_tpu.advisor import cli
+
+        data = _lake(tmp_path, rows=4_000, files=2)
+        recs = replay.skewed_keys(
+            [data], "key", [1, 2, 3], 6, project=["key", "payload"]
+        )
+        obs_dir = str(tmp_path / "obs")
+        replay.record_workload(recs, obs_dir)
+        assert cli.main(["report", "--log-dir", obs_dir]) == 0
+        out = capsys.readouterr().out
+        assert "records=6" in out and "replay=y" in out
+        assert (
+            cli.main(
+                ["recommend", "--log-dir", obs_dir,
+                 "--system-path", str(tmp_path / "idx")]
+            )
+            == 0
+        )
+        out = capsys.readouterr().out
+        assert "recommendations" in out

@@ -1,0 +1,129 @@
+"""Query-log READER hardening (obs/querylog.py, docs/advisor.md).
+
+The advisor mines logs written by a fleet of processes that crash,
+rotate and upgrade independently — so the reader contract is: union
+everything readable, skip everything else, raise never. Three legs:
+
+* torn trailing lines (a writer died mid-append) are skipped while
+  every complete line before AND after the tear still reads;
+* the unsealed active file of a writer that crashed mid-rotation
+  (``mid_querylog_rotate``) is picked up by the union alongside other
+  processes' segments;
+* records with an unknown/newer ``schema_v`` are dropped by
+  ``read_valid_records`` with a counter increment — a half-upgraded
+  fleet's mixed log profiles cleanly on the old binary.
+"""
+
+import json
+import os
+
+import pytest
+
+from hyperspace_tpu.obs import metrics, querylog
+from hyperspace_tpu.testing import faults
+from hyperspace_tpu.testing.faults import SimulatedCrash
+
+
+def _rec(i, **over):
+    rec = {
+        "schema_v": querylog.SCHEMA_V,
+        "ts_ms": 1000 + i,
+        "fingerprint": f"fp{i}",
+        "duration_s": 0.01,
+        "status": "ok",
+        "stages": {"scan": 0.001},
+        "rows_returned": i,
+    }
+    rec.update(over)
+    return rec
+
+
+def _write_segment(path, records, tail=""):
+    with open(path, "w", encoding="utf-8") as fh:
+        for rec in records:
+            fh.write(json.dumps(rec) + "\n")
+        fh.write(tail)
+
+
+class TestTornTail:
+    def test_torn_tail_skipped_rest_reads(self, tmp_path):
+        d = str(tmp_path)
+        _write_segment(
+            os.path.join(d, "querylog.1.aaaa.jsonl"),
+            [_rec(0), _rec(1)],
+            tail='{"schema_v": 1, "fingerprint": "torn", "dur',
+        )
+        got = querylog.read_records(d)
+        assert [r["fingerprint"] for r in got] == ["fp0", "fp1"]
+
+    def test_torn_line_mid_union_does_not_hide_other_files(self, tmp_path):
+        """The tear is per-file: a second process's segment still
+        contributes every record."""
+        d = str(tmp_path)
+        _write_segment(
+            os.path.join(d, "querylog.1.aaaa.jsonl"), [_rec(0)], tail="{garbage"
+        )
+        _write_segment(os.path.join(d, "querylog.2.bbbb.jsonl"), [_rec(1), _rec(2)])
+        fps = {r["fingerprint"] for r in querylog.read_records(d)}
+        assert fps == {"fp0", "fp1", "fp2"}
+
+    def test_empty_and_missing_directory(self, tmp_path):
+        assert querylog.read_records(str(tmp_path / "nope")) == []
+        assert querylog.read_valid_records(str(tmp_path / "nope")) == []
+
+
+class TestCrashedWriterPickup:
+    def test_unsealed_active_file_reads_after_mid_rotate_crash(self, tmp_path):
+        """A writer that crashed between the active file's fsync and
+        the sealed rename leaves an UNSEALED active file; the union
+        reads it next to a healthy writer's segments — zero loss."""
+        d = str(tmp_path / "obslog")
+        faults.set_crash("mid_querylog_rotate", "raise")
+        log = querylog.QueryLog(d, max_bytes=256, max_files=8)
+        written = 0
+        with pytest.raises(SimulatedCrash):
+            for i in range(64):
+                assert log.append(_rec(i, fingerprint=f"dead{i}"))
+                written += 1
+        written += 1  # the rotating append was durable pre-crash
+        # a healthy incarnation (fresh tag) appends alongside
+        log2 = querylog.QueryLog(d, max_bytes=1 << 20, max_files=8)
+        for i in range(3):
+            assert log2.append(_rec(i, fingerprint=f"live{i}"))
+        log2.close()
+        got = querylog.read_valid_records(d)
+        fps = [r["fingerprint"] for r in got]
+        assert sum(1 for f in fps if f.startswith("dead")) == written
+        assert sum(1 for f in fps if f.startswith("live")) == 3
+        for r in got:
+            assert querylog.validate_record(r) is None, r
+
+
+class TestSchemaVersionSkip:
+    def test_unknown_schema_v_skipped_with_counter(self, tmp_path):
+        d = str(tmp_path)
+        _write_segment(
+            os.path.join(d, "querylog.1.aaaa.jsonl"),
+            [
+                _rec(0),
+                _rec(1, schema_v=querylog.SCHEMA_V + 7),  # future binary
+                _rec(2, schema_v="one"),  # corrupt type
+                _rec(3, schema_v=True),  # bool is not an int here
+                _rec(4),
+            ],
+        )
+        before = metrics.querylog_skipped_total.value
+        got = querylog.read_valid_records(d)
+        assert [r["fingerprint"] for r in got] == ["fp0", "fp4"]
+        assert metrics.querylog_skipped_total.value - before == 3
+
+    def test_read_records_keeps_what_valid_reader_drops(self, tmp_path):
+        """``read_records`` stays the raw union (crash tests and future
+        binaries use it); only ``read_valid_records`` filters."""
+        d = str(tmp_path)
+        _write_segment(
+            os.path.join(d, "querylog.1.aaaa.jsonl"),
+            [_rec(0), _rec(1, schema_v=99)],
+        )
+        assert len(querylog.read_records(d)) == 2
+        assert len(querylog.read_valid_records(d)) == 1
