@@ -753,6 +753,22 @@ def main() -> None:
                 out = fe.serve(q)
                 assert out.equals(base_t), (point, spec)
             _flt.clear()
+        # the fastbus_send seam lives on the fleet fast plane (serve/
+        # fastbus.py), not the single-process serve path: fire it at the
+        # transport directly — an armed fault surfaces as the typed
+        # OSError every caller catches to fall back to the durable
+        # planes (the fleet ladder's chaos rung witnesses that fallback
+        # end to end)
+        from hyperspace_tpu.serve import fastbus as _fastbus
+        from hyperspace_tpu.testing.faults import InjectedFault as _IF
+
+        _flt.set_fault("fastbus_send", "transient:1")
+        try:
+            _fastbus.push(os.path.join(tmp, "no-such.sock"), {"type": "event"})
+            raise AssertionError("armed fastbus_send did not fire")
+        except _IF:
+            pass
+        _flt.clear()
         fault_stats = fe.stats()
         fe.close()
         fault_fired = _flt.stats()
@@ -797,17 +813,23 @@ def main() -> None:
         # serve.md): N REAL frontend processes over one lake, identical
         # schedules from a barrier start — the horizontal twin of the
         # 1/8/64-client ladder above. Each rung reports aggregate QPS,
-        # cross-process dedup (the single-flight that saved 256/512
-        # queries at one process must not regress to 0 at eight), and
-        # the two zeros bench_smoke.sh gates on: wrong answers and
-        # leaked pin files. The final rung is the chaos rung: kill -9
-        # one frontend mid-serve, survivors still bit-identical, the
-        # dead frontend's durable pins reaped at lease expiry.
+        # cross-process dedup (claim/spool wins OR fast-plane handoffs/
+        # result-cache hits — the dedup that saved 256/512 queries at
+        # one process must not regress to 0 at eight), the fast-plane
+        # witnesses (pushed fanout events received, spool-free result
+        # handoffs, push-vs-poll wait milliseconds), and the zeros
+        # bench_smoke.sh gates on: wrong answers, leaked pin files,
+        # leaked member/socket files. The final rung is the chaos rung:
+        # kill -9 one frontend mid-serve, survivors degrade fast ->
+        # durable bit-identically, the dead frontend's durable pins and
+        # fast-plane member file reaped at lease expiry.
         from hyperspace_tpu.testing import fleet_harness as _fleet
 
         fleet_procs = [
             int(x)
-            for x in os.environ.get("HS_BENCH_FLEET", "2,4,8").split(",")
+            for x in os.environ.get(
+                "HS_BENCH_FLEET", "2,4,8,16,32"
+            ).split(",")
             if x.strip()
         ]
         fleet_iters = int(os.environ.get("HS_BENCH_FLEET_ITERS", 8))
@@ -821,31 +843,92 @@ def main() -> None:
                 n_procs=np_,
                 iters=fleet_iters,
                 reuse_lake=fleet_lake,
+                fastpath_phase=True,
             )
             assert row["wrong_answers"] == 0, row
             assert row["leaked_pin_files"] == 0, row
-            assert row["cross_process_dedup"] > 0, row
+            assert row["leaked_fast_members"] == 0, row
+            assert row["fast_frontends"] == np_, row
+            # dedup may land on any plane: claim/spool wins, owner-routed
+            # handoffs, or fast result-cache hits
+            assert (
+                row["cross_process_dedup"]
+                + row["fast_handoffs"]
+                + row["fast_result_hits"]
+                > 0
+            ), row
+            # the deterministic fast-path witnesses (two-phase harness):
+            # every live worker received the parent refresh as a PUSH,
+            # and served at least one spool-free owner-routed probe
+            assert row["fast_push_received"] >= 1, row
+            assert row["fast_handoffs"] >= 1, row
             fleet_ladder.append(row)
+            fast_avg = row["fast_wait_ms_total"] / max(1, row["fast_waits"])
+            poll_avg = row["poll_wait_ms_total"] / max(1, row["poll_waits"])
             log(
                 f"fleet {np_} procs: {row['qps']} qps aggregate, p50 "
-                f"{row['p50_ms']}ms p99 {row['p99_ms']}ms, cross-process "
-                f"dedup {row['cross_process_dedup']}/{row['queries']}, "
-                f"0 wrong / 0 leaked pins"
+                f"{row['p50_ms']}ms p99 {row['p99_ms']}ms, dedup "
+                f"{row['cross_process_dedup']}+{row['fast_handoffs']}fast"
+                f"/{row['queries']}, push recv {row['fast_push_received']}, "
+                f"waits fast {row['fast_waits']}x{fast_avg:.2f}ms vs poll "
+                f"{row['poll_waits']}x{poll_avg:.2f}ms, 0 wrong / 0 leaked"
             )
+        # ladder shape gates: QPS monotone through the rungs (within
+        # run-to-run jitter), and the 2-process rung beating the
+        # single-process 64-client rung — the whole point of replacing
+        # elections + fsync'd spool round-trips with owner routing
+        for prev, cur in zip(fleet_ladder, fleet_ladder[1:]):
+            assert cur["qps"] >= prev["qps"] * 0.85, (
+                "fleet ladder QPS not monotone",
+                prev["processes"],
+                prev["qps"],
+                cur["processes"],
+                cur["qps"],
+            )
+        serve64 = next(
+            (r for r in serve_concurrency if r["clients"] == 64), None
+        )
+        fleet2 = next(
+            (r for r in fleet_ladder if r["processes"] == 2), None
+        )
+        fleet_vs_single = None
+        if serve64 is not None and fleet2 is not None:
+            fleet_vs_single = {
+                "single_process_64c_qps": serve64["qps"],
+                "fleet_2proc_qps": fleet2["qps"],
+                "beats_single": bool(fleet2["qps"] > serve64["qps"]),
+            }
+            log(
+                f"fleet 2-proc {fleet2['qps']} qps vs single-process "
+                f"64-client {serve64['qps']} qps -> "
+                f"{'BEATS' if fleet_vs_single['beats_single'] else 'TRAILS'}"
+            )
+            if os.environ.get("HS_BENCH_FLEET_STRICT"):
+                # the acceptance bar holds at the real rung; tiny smoke
+                # rows measure process-spawn overhead, not the plane
+                assert fleet_vs_single["beats_single"], fleet_vs_single
         fleet_chaos = _fleet.run_fleet(
             os.path.join(fleet_root, "chaos"),
             n_procs=max(fleet_procs) if fleet_procs else 2,
             iters=fleet_iters,
             kill_one=True,
             reuse_lake=fleet_lake,
+            fastpath_phase=True,
         )
         assert fleet_chaos["wrong_answers"] == 0, fleet_chaos
         assert fleet_chaos["leaked_pin_files"] == 0, fleet_chaos
+        assert fleet_chaos["leaked_fast_members"] == 0, fleet_chaos
+        # fast -> durable degradation witnessed: survivors probed the
+        # dead owner's digests, paid one failed connect each, and fell
+        # back to the claim/spool plane bit-identically
+        assert fleet_chaos["fast_fallbacks"] >= 1, fleet_chaos
         log(
             f"fleet chaos (kill -9 one of {fleet_chaos['processes']}): "
             f"{fleet_chaos['workers_reporting']} survivors, 0 wrong "
-            f"answers, 0 leaked pins, dedup "
-            f"{fleet_chaos['cross_process_dedup']}"
+            f"answers, 0 leaked pins/members, dedup "
+            f"{fleet_chaos['cross_process_dedup']}, fast->durable "
+            f"fallbacks {fleet_chaos['fast_fallbacks']}, p99 "
+            f"{fleet_chaos['p99_ms']}ms"
         )
 
         session.conf.set(C.SERVE_CACHE_ENABLED, False)
@@ -1578,6 +1661,7 @@ def main() -> None:
                     "serve_obs": serve_obs,
                     "advisor": advisor_rung,
                     "fleet_ladder": fleet_ladder,
+                    "fleet_vs_single": fleet_vs_single,
                     "fleet_chaos": fleet_chaos,
                     "fleet_vs_64client_qps": round(
                         fleet_ladder[-1]["qps"]

@@ -132,6 +132,19 @@ class _ModuleIndex:
         self.functions: Set[str] = set()
         self.classes: Dict[str, Set[str]] = {}  # class -> method names
         self.class_locks: Dict[str, Set[str]] = {}  # class -> lock attrs
+        # class -> base-class exprs, as written (resolved after all
+        # modules are indexed — a base usually lives in another file)
+        self.class_bases: Dict[str, List[ast.expr]] = {}
+        # class -> {lock attr -> base-class LockId} for locks the class
+        # INHERITS rather than assigns: ``self._lock`` in a subclass
+        # method is the base's lock object (attribute lookup is
+        # dynamic), so it must resolve to the base's identity or the
+        # ordering graph would fork one lock into two
+        self.inherited_locks: Dict[str, Dict[str, LockId]] = {}
+        # class -> {(rel, class)} of every in-package ancestor,
+        # transitively (dynamic dispatch: a call through a base-class
+        # method key may execute a subclass override)
+        self.resolved_bases: Dict[str, Set[Tuple[str, str]]] = {}
 
     def qualified_to_rel(self, qualified: str) -> Optional[str]:
         """'hyperspace_tpu.native' -> 'native/__init__.py' (or .py file)."""
@@ -179,7 +192,66 @@ def _collect_defs(project: Project) -> Tuple[Dict[str, _ModuleIndex], Set[LockId
                                 lock_attrs.add(t.attr)
                                 locks.add((f"cls:{rel}:{node.name}", t.attr))
                 idx.class_locks[node.name] = lock_attrs
+                if node.bases:
+                    idx.class_bases[node.name] = list(node.bases)
+    _link_inherited_locks(indexes)
     return indexes, locks
+
+
+def _resolve_base_class(
+    idx: _ModuleIndex, indexes: Dict[str, _ModuleIndex], base: ast.expr
+) -> Optional[Tuple[str, str]]:
+    """``(rel, class)`` a base-class expression names, or None for
+    anything outside the package (stdlib/third-party bases hold no locks
+    we model)."""
+    name = dotted_name(base)
+    if not name:
+        return None
+    head, _, rest = name.partition(".")
+    full = idx.aliases.get(head, head) + (f".{rest}" if rest else "")
+    mod, _, cls = full.rpartition(".")
+    if not mod:  # same-module base, unqualified
+        return (idx.rel, cls) if cls in idx.classes else None
+    brel = idx.qualified_to_rel(mod)
+    if brel is None or cls not in indexes[brel].classes:
+        return None
+    return brel, cls
+
+
+def _link_inherited_locks(indexes: Dict[str, _ModuleIndex]) -> None:
+    """Propagate lock attributes down single-inheritance chains: a
+    subclass that does NOT assign ``self.<attr>`` itself sees the base's
+    lock under the base's LockId. Fixpoint handles multi-level chains
+    regardless of file iteration order; a subclass re-assigning the
+    attr shadows the base (its own class_locks entry wins)."""
+    changed = True
+    while changed:
+        changed = False
+        for idx in indexes.values():
+            for cls, bases in idx.class_bases.items():
+                own = idx.inherited_locks.setdefault(cls, {})
+                ancestors = idx.resolved_bases.setdefault(cls, set())
+                for base in bases:
+                    target = _resolve_base_class(idx, indexes, base)
+                    if target is None:
+                        continue
+                    brel, bcls = target
+                    bidx = indexes[brel]
+                    lineage = {target} | bidx.resolved_bases.get(bcls, set())
+                    if not lineage <= ancestors:
+                        ancestors |= lineage
+                        changed = True
+                    merged: Dict[str, LockId] = dict(
+                        bidx.inherited_locks.get(bcls, {})
+                    )
+                    for attr in bidx.class_locks.get(bcls, ()):
+                        merged[attr] = (f"cls:{brel}:{bcls}", attr)
+                    for attr, lock_id in merged.items():
+                        if attr in idx.class_locks.get(cls, ()):
+                            continue  # shadowed by the subclass's own lock
+                        if own.get(attr) != lock_id:
+                            own[attr] = lock_id
+                            changed = True
 
 
 def _resolve_lock(
@@ -192,9 +264,10 @@ def _resolve_lock(
         and isinstance(node.value, ast.Name)
         and node.value.id == "self"
         and cls is not None
-        and node.attr in idx.class_locks.get(cls, ())
     ):
-        return (f"cls:{idx.rel}:{cls}", node.attr)
+        if node.attr in idx.class_locks.get(cls, ()):
+            return (f"cls:{idx.rel}:{cls}", node.attr)
+        return idx.inherited_locks.get(cls, {}).get(node.attr)
     return None
 
 

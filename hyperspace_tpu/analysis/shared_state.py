@@ -142,6 +142,12 @@ class FnInfo:
     calls: Set[FuncKey] = dataclasses.field(default_factory=set)
     submits: Set[FuncKey] = dataclasses.field(default_factory=set)
     accesses: List[Access] = dataclasses.field(default_factory=list)
+    # (callee, locks held at the call site) — feeds the ``*_locked``
+    # caller-held credit (one entry per call expression, so the
+    # intersection over edges is over ALL call sites)
+    call_held: List[Tuple[FuncKey, frozenset]] = dataclasses.field(
+        default_factory=list
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -285,7 +291,10 @@ class _PkgIndex:
             attr = spec[len("self.") :]
             if attr in self.locks_idx[rel].class_locks.get(cls, ()):
                 return (f"cls:{rel}:{cls}", attr)
-            return None
+            # a lock the class INHERITS resolves to the base's identity
+            # (locks._link_inherited_locks), so `self._lock` entries on
+            # a subclass audit against the one real lock object
+            return self.locks_idx[rel].inherited_locks.get(cls, {}).get(attr)
         parts = spec.split(".")
         for i in range(len(parts) - 1, 0, -1):
             rel = self.rel_for(".".join(parts[:i]))
@@ -685,6 +694,7 @@ class _FnWalker:
         callee = self._resolve_callable(f)
         if callee is not None:
             self.info.calls.add(callee)
+            self.info.call_held.append((callee, frozenset(self.held)))
         self._expr(f)
         for a in call.args:
             self._expr(a)
@@ -778,6 +788,69 @@ def _state_name(state: StateId) -> str:
     if state[0] == "mod":
         return f"{state[1]}::{state[2]}"
     return f"{state[1]}::{state[2]}.{state[3]}"
+
+
+def _locked_credits(checker: "_Checker") -> Dict[FuncKey, frozenset]:
+    """Caller-held lock credit for ``*_locked`` functions.
+
+    The codebase convention (``_dispatch_pending_locked``,
+    ``_fast_cache_get_locked``, …): a ``_locked`` suffix promises "my
+    caller holds the lock". This VERIFIES the promise instead of
+    trusting it — the credit is the INTERSECTION of the locks held at
+    every resolved call site (inheritance-aware: a call through a
+    base-class method key may dispatch to a subclass override), so one
+    lock-free call site voids the credit and HS602 fires at the access.
+    Pool-submitted ``_locked`` callables get no credit (they run with
+    an empty held set by definition), and a ``_locked`` function with
+    no resolved call sites gets none either. Fixpoint: a ``_locked``
+    caller's own credit counts at its call sites, so helper chains
+    (``a_locked`` -> ``b_locked``) resolve; credits only grow, so the
+    iteration terminates."""
+    submitted: Set[FuncKey] = set()
+    for info in checker.infos.values():
+        submitted |= info.submits
+    locked_keys = [
+        k
+        for k in checker.infos
+        if k[2].endswith("_locked") and k not in submitted
+    ]
+    if not locked_keys:
+        return {}
+    # callee key -> every *_locked key it may dispatch to: itself, plus
+    # any subclass override of the same method name
+    dispatch: Dict[FuncKey, List[FuncKey]] = {}
+    for k in locked_keys:
+        rel, cls, name = k
+        dispatch.setdefault(k, []).append(k)
+        if cls is None:
+            continue
+        ancestors = checker.pkg_idx.locks_idx[rel].resolved_bases.get(
+            cls, set()
+        )
+        for arel, acls in ancestors:
+            dispatch.setdefault((arel, acls, name), []).append(k)
+    # k -> [(caller, held at call site)]
+    edges: Dict[FuncKey, List[Tuple[FuncKey, frozenset]]] = {}
+    for caller, info in checker.infos.items():
+        for callee, held in info.call_held:
+            for k in dispatch.get(callee, ()):
+                edges.setdefault(k, []).append((caller, held))
+    credits: Dict[FuncKey, frozenset] = {}
+    changed = True
+    while changed:
+        changed = False
+        for k in locked_keys:
+            sites = edges.get(k)
+            if not sites:
+                continue
+            inter: Optional[frozenset] = None
+            for caller, held in sites:
+                eff = held | credits.get(caller, frozenset())
+                inter = eff if inter is None else inter & eff
+            if inter and inter != credits.get(k, frozenset()):
+                credits[k] = inter
+                changed = True
+    return credits
 
 
 def check(project: Project) -> List[Finding]:
@@ -885,23 +958,26 @@ def check(project: Project) -> List[Finding]:
             )
 
     # -- HS602: registered state must honor its policy ----------------------
+    credits = _locked_credits(checker)
     seen_602: Set[Tuple[StateId, str, int]] = set()
     for key, info in sorted(checker.infos.items(), key=lambda kv: str(kv[0])):
         if key[1] is not None and key[2].split(".")[0] == "__init__":
             continue  # construction happens-before sharing
+        credit = credits.get(key, frozenset())
         for a in info.accesses:
             e = registered.get(a.state)
             if e is None:
                 continue
+            held = a.held | credit
             bad: Optional[str] = None
             if e.policy == "guarded":
-                if e.lock not in a.held:
+                if e.lock not in held:
                     bad = (
                         f"accessed without {e.lock_spec} held "
                         "(policy: guarded)"
                     )
             elif e.policy == "guarded-writes":
-                if a.kind != "read" and e.lock not in a.held:
+                if a.kind != "read" and e.lock not in held:
                     bad = (
                         f"written without {e.lock_spec} held "
                         "(policy: guarded-writes)"

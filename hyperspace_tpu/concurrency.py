@@ -296,6 +296,54 @@ SHARED_STATE: Dict[str, Tuple[str, str, str]] = {
         "the lifecycle-action hook) increments under the one lock so "
         "same-millisecond publishes cannot collide on a file name",
     ),
+    # -- fleet fast plane (serve/fleet.py) -----------------------------------
+    "hyperspace_tpu.serve.fleet.FleetFrontend._fast_results": (
+        "self._lock",
+        "guarded",
+        "the digest->Arrow-result LRU served to routed peers; get/put/"
+        "evict from serve workers and fast-bus handler threads all hold "
+        "the frontend lock",
+    ),
+    "hyperspace_tpu.serve.fleet.FleetFrontend._fast_results_bytes": (
+        "self._lock",
+        "guarded",
+        "byte ledger of the fast result cache (resultCacheBytes bound); "
+        "every read-modify-write runs under the same lock as the cache "
+        "it accounts for",
+    ),
+    "hyperspace_tpu.serve.fleet.FleetFrontend._fast_inflight": (
+        "self._lock",
+        "guarded",
+        "owner-side single-flight map (digest -> Future): lookup+insert "
+        "must be atomic or two identical routed requests both execute",
+    ),
+    "hyperspace_tpu.serve.fleet.FleetFrontend._wake_events": (
+        "self._lock",
+        "guarded",
+        "digest -> (Event, waiters) parking lot for spool waiters woken "
+        "by result-ready pushes; register/unregister/wake from poll "
+        "loops and handler threads all hold the frontend lock",
+    ),
+    "hyperspace_tpu.serve.fleet.FleetFrontend._fast_applied": (
+        "self._lock",
+        "guarded",
+        "bus-event names applied via fast push, consulted by the "
+        "durable poll to dedup push-vs-poll delivery; add/discard/"
+        "membership all hold the frontend lock",
+    ),
+    "hyperspace_tpu.serve.fleet.FleetFrontend._fast_applied_order": (
+        "self._lock",
+        "guarded",
+        "FIFO eviction order of the applied-name dedup set, mutated in "
+        "the same critical sections as the set it bounds",
+    ),
+    "hyperspace_tpu.serve.fleet.FleetFrontend._peer_slo": (
+        "self._lock",
+        "guarded",
+        "gossiped per-peer SLO class depths (owner -> (stamp, classes)) "
+        "read by the admission check and written by the gossip handler; "
+        "both hold the frontend lock",
+    ),
     # -- fault injection (testing/faults.py) ---------------------------------
     "hyperspace_tpu.testing.faults._crash_active": (
         "hyperspace_tpu.testing.faults._lock",

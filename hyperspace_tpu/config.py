@@ -552,6 +552,64 @@ class Config:
             ),
         )
 
+    # -- fleet fast data plane (serve/fastbus.py, serve/router.py) -----------
+    @property
+    def fleet_fast_enabled(self) -> bool:
+        """Fast data plane over the durable fleet planes: per-host push
+        bus + owner routing (docs/fleet-serve.md, "Fast data plane")."""
+        return self.get_bool(C.FLEET_FAST_ENABLED, C.FLEET_FAST_ENABLED_DEFAULT)
+
+    @property
+    def fleet_fast_routing_enabled(self) -> bool:
+        return self.get_bool(
+            C.FLEET_FAST_ROUTING_ENABLED, C.FLEET_FAST_ROUTING_ENABLED_DEFAULT
+        )
+
+    @property
+    def fleet_fast_request_timeout_ms(self) -> int:
+        return max(
+            1,
+            self.get_int(
+                C.FLEET_FAST_REQUEST_TIMEOUT_MS,
+                C.FLEET_FAST_REQUEST_TIMEOUT_MS_DEFAULT,
+            ),
+        )
+
+    @property
+    def fleet_fast_member_lease_ms(self) -> int:
+        return max(
+            1,
+            self.get_int(
+                C.FLEET_FAST_MEMBER_LEASE_MS,
+                C.FLEET_FAST_MEMBER_LEASE_MS_DEFAULT,
+            ),
+        )
+
+    @property
+    def fleet_fast_result_cache_bytes(self) -> int:
+        return max(
+            0,
+            self.get_int(
+                C.FLEET_FAST_RESULT_CACHE_BYTES,
+                C.FLEET_FAST_RESULT_CACHE_BYTES_DEFAULT,
+            ),
+        )
+
+    @property
+    def fleet_fast_gossip_ms(self) -> int:
+        return max(
+            1,
+            self.get_int(
+                C.FLEET_FAST_GOSSIP_MS, C.FLEET_FAST_GOSSIP_MS_DEFAULT
+            ),
+        )
+
+    @property
+    def fleet_fast_slo_fleet_wide(self) -> bool:
+        return self.get_bool(
+            C.FLEET_FAST_SLO_FLEET_WIDE, C.FLEET_FAST_SLO_FLEET_WIDE_DEFAULT
+        )
+
     @property
     def fleet_slo_classes(self) -> dict:
         """``{class name: (max_concurrency, max_queue_depth)}`` from the

@@ -620,6 +620,63 @@ FLEET_SPOOL_MAX_BYTES_DEFAULT = 256 << 20  # 256 MiB
 # an unconfigured class name) see only the global bounds.
 FLEET_CLASS_KEY_PREFIX = "hyperspace.fleet.class."
 
+# -- fleet fast data plane (serve/fastbus.py, serve/router.py) ---------------
+# The durable planes above coordinate through files and polling — always
+# correct, but the polling tax dominates at small fleets (ROADMAP item
+# 3). The fast plane layers a per-host push bus (Unix sockets announced
+# through lease-stamped member files under _hyperspace_fleet/members/)
+# and owner routing (rendezvous-hash the plan digest to one member, ship
+# the plan spec, stream the Arrow result back — no claim election, no
+# fsync'd spool round-trip) on top. Every fast-path message is
+# idempotently replayable from the durable planes: a dropped push costs
+# a poll interval, a dead owner costs one failed connect and a fallback
+# to the claim/spool path — never a wrong answer. Off = PR 14 behavior.
+FLEET_FAST_ENABLED = "hyperspace.fleet.fast.enabled"
+FLEET_FAST_ENABLED_DEFAULT = True
+
+# Owner routing sub-switch: with it off the fast plane still pushes
+# fanout events, result-ready wakeups and SLO gossip, but single-flight
+# stays on the claim/spool election (useful to isolate a routing bug in
+# production without losing push latency).
+FLEET_FAST_ROUTING_ENABLED = "hyperspace.fleet.fast.routing.enabled"
+FLEET_FAST_ROUTING_ENABLED_DEFAULT = True
+
+# Round-trip budget for one owner-routed execution request. A timeout
+# (or any send/receive failure, including an armed fastbus_send fault)
+# falls back to the durable single-flight plane — the budget bounds the
+# p99 blip when an owner dies, it never forfeits the answer.
+FLEET_FAST_REQUEST_TIMEOUT_MS = "hyperspace.fleet.fast.requestTimeoutMs"
+FLEET_FAST_REQUEST_TIMEOUT_MS_DEFAULT = 2_000
+
+# Member lease: each frontend announces its socket in a lease-expiring
+# member file renewed every leaseMs/3 by the router maintenance thread;
+# a member whose lease expired is a dead process (kill -9, OOM) — peers
+# reap its member file AND its socket file, and rendezvous routing stops
+# offering it work. The same discriminator as the writer and pin leases.
+FLEET_FAST_MEMBER_LEASE_MS = "hyperspace.fleet.fast.memberLeaseMs"
+FLEET_FAST_MEMBER_LEASE_MS_DEFAULT = 10_000
+
+# Byte budget for the in-memory digest->result cache each member keeps
+# (LRU, measured by Arrow table nbytes). Results are snapshot-addressed
+# like the spool, so a cached entry can be stale only in the sense of
+# unreachable — a refresh re-keys every digest. 0 disables the cache.
+FLEET_FAST_RESULT_CACHE_BYTES = "hyperspace.fleet.fast.resultCacheBytes"
+FLEET_FAST_RESULT_CACHE_BYTES_DEFAULT = 64 << 20  # 64 MiB
+
+# Queue-depth gossip cadence: each member pushes its per-class
+# running+pending depths to every live peer this often, feeding the
+# fleet-wide SLO admission check. Entries older than ~10 gossip periods
+# are ignored (a dead peer must not pin its last-known depth forever).
+FLEET_FAST_GOSSIP_MS = "hyperspace.fleet.fast.gossipMs"
+FLEET_FAST_GOSSIP_MS_DEFAULT = 50
+
+# Fleet-wide SLO enforcement: when on, the per-tenant class queue-depth
+# bound counts the gossiped depths of live peers too, so a batch tier
+# saturating ONE process sheds fleet-wide before the interactive tier
+# feels pressure on ANY process. Off = per-process depths (PR 14).
+FLEET_FAST_SLO_FLEET_WIDE = "hyperspace.fleet.fast.sloFleetWide"
+FLEET_FAST_SLO_FLEET_WIDE_DEFAULT = True
+
 # Durable pin directory name (underscore-prefixed, next to the log —
 # invisible to data scans like the quarantine dir).
 HYPERSPACE_PINS_DIR = "_hyperspace_pins"

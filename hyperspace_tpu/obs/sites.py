@@ -138,6 +138,12 @@ OBS_SITES: Dict[str, Tuple[str, str]] = {
         "spill_restore makes the cost of serving from the disk tier "
         "visible next to the scan/prepare stages it displaces",
     ),
+    "hyperspace_tpu.serve.fleet": (
+        "metric",
+        "cross-process single-flight election attempts/wins/losses as "
+        "process-global counters: election health is fleet-level "
+        "telemetry every sink must export, not one frontend's stats()",
+    ),
     "hyperspace_tpu.execution.join_exec": (
         "metric",
         "last_serve_breakdown IS this stage_timer's backing dict — the "

@@ -122,6 +122,10 @@ class FleetBus:
         name = f"{_now_ms():013d}.{self.owner}.{_next_seq():06d}.json"
         payload = dict(event)
         payload["owner"] = self.owner
+        # the durable name rides IN the payload too: the fast push plane
+        # forwards the same payload, and subscribers dedup push-vs-poll
+        # delivery by this name
+        payload["name"] = name
         try:
             file_utils.atomic_overwrite(
                 os.path.join(self.directory, name), json.dumps(payload)
@@ -260,11 +264,21 @@ def publish_action_event(session, index_name, index_path, action_name, entry):
             payload = aggindex.fanout_payload(entry.content.files)
             if payload is not None:
                 event["aggstate"] = payload
-        FleetBus(
+        bus = FleetBus(
             bus_dir(conf),
             poll_ms=conf.fleet_bus_poll_ms,
             retain_ms=conf.fleet_bus_retain_ms,
-        ).publish(event)
+        )
+        name = bus.publish(event)
+        if name is not None and conf.fleet_fast_enabled:
+            # fast fanout AFTER the durable write: peers the push
+            # reaches evict in microseconds; peers it misses see the
+            # identical payload (same "name") at their next poll
+            from hyperspace_tpu.serve import router as fleet_router
+
+            fleet_router.push_event_to_members(
+                conf, {**event, "owner": bus.owner, "name": name}
+            )
     except Exception as exc:  # hslint: disable=HS402
         # catch-all IS the contract: fanout is best-effort by design
         _log.warning("fleet bus publish failed for %s: %s", index_name, exc)

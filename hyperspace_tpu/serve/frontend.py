@@ -271,7 +271,7 @@ class ServeFrontend:
         # the documented contract. Depth is re-checked at enqueue (the
         # pin read dropped the lock in between).
         with self._lock:
-            self._check_admittable(cls)
+            self._check_admittable_locked(cls)
         # the query ROOT span starts HERE so queue-wait is on the trace;
         # a query that dedups onto an in-flight twin abandons it
         # unfinished (one root span per EXECUTION is the contract —
@@ -311,7 +311,7 @@ class ServeFrontend:
                     self._deduped += 1
                     recovery.release_pins(pin_token)
                     return existing
-                self._check_admittable(cls)
+                self._check_admittable_locked(cls)
                 self._queued += 1
                 self._admitted += 1
                 if cls is not None:
@@ -335,25 +335,33 @@ class ServeFrontend:
         fut.add_done_callback(lambda _f, fp=fp: self._forget(fp))
         return fut
 
-    def _check_admittable(self, cls: Optional[_SloClass] = None) -> None:
+    def _fleet_class_depth_locked(self, cls: _SloClass) -> int:
+        """Peers' contribution to this class's queue depth (called with
+        the lock held). The single-process frontend has no peers;
+        ``FleetFrontend`` overrides this with gossiped live depths so a
+        class bound is enforced FLEET-wide, not per-process."""
+        return 0
+
+    def _check_admittable_locked(self, cls: Optional[_SloClass] = None) -> None:
         """Raise unless a new query may enter (call with the lock held).
         The class bound is checked FIRST: a tenant over its own budget
         sheds with its class named, before it can pressure the global
         queue every other tenant shares."""
         if self._closed:
             raise HyperspaceException("ServeFrontend is closed")
-        if (
-            cls is not None
-            and cls.max_queue_depth > 0
-            and len(cls.pending) + cls.running >= cls.max_queue_depth
-        ):
-            cls.shed += 1
-            self._shed += 1
-            raise ServeOverloadedError(
-                f"SLO class {cls.name!r} queue full ({cls.running} running "
-                f"+ {len(cls.pending)} pending >= maxQueueDepth "
-                f"{cls.max_queue_depth}); shedding"
-            )
+        if cls is not None and cls.max_queue_depth > 0:
+            fleet_depth = self._fleet_class_depth_locked(cls)
+            if (
+                len(cls.pending) + cls.running + fleet_depth
+                >= cls.max_queue_depth
+            ):
+                cls.shed += 1
+                self._shed += 1
+                raise ServeOverloadedError(
+                    f"SLO class {cls.name!r} queue full ({cls.running} "
+                    f"running + {len(cls.pending)} pending + {fleet_depth} "
+                    f"fleet >= maxQueueDepth {cls.max_queue_depth}); shedding"
+                )
         if self._max_queue > 0 and self._queued >= self._max_queue:
             self._shed += 1
             raise ServeOverloadedError(

@@ -2,7 +2,7 @@
 
 Exoshuffle (PAPERS.md) argues fault handling belongs in the
 application-level dataflow — retried or degraded at the operation
-boundary — not bolted underneath it. To make that testable, the four
+boundary — not bolted underneath it. To make that testable, the five
 I/O-and-dispatch seams of the serve path carry an injection point each:
 
 ========================  ====================================================
@@ -21,6 +21,12 @@ point                     armed site
 ``cache_insert``          ``ServeCache.put`` — a fired fault drops the insert
                           (query still answers, just uncached; counted in
                           ``ServeCache.insert_failures``)
+``fastbus_send``          ``serve/fastbus.push`` / ``request`` — the fleet
+                          fast data plane's send seam; a fired fault is a
+                          dead/unreachable peer socket, and the contract is
+                          fall back to the durable planes (poll-delivered
+                          fanout, claim/spool single-flight) with a
+                          bit-identical answer
 ========================  ====================================================
 
 Arming is always an explicit act: programmatic (:func:`set_fault`) or
@@ -98,7 +104,13 @@ import os
 import threading
 from typing import Dict, Optional
 
-POINTS = ("parquet_read", "kernel_dispatch", "log_read", "cache_insert")
+POINTS = (
+    "parquet_read",
+    "kernel_dispatch",
+    "log_read",
+    "cache_insert",
+    "fastbus_send",
+)
 
 CRASH_POINTS = (
     "after_begin_log",

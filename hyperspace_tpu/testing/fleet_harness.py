@@ -35,6 +35,7 @@ import signal
 import subprocess
 import sys
 import time
+import uuid
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -54,6 +55,12 @@ WORKER_CONF = {
     C.FLEET_PIN_LEASE_MS: 2_000,
     C.FLEET_SINGLEFLIGHT_WAIT_MS: 3_000,
     C.FLEET_SINGLEFLIGHT_CLAIM_MS: 4_000,
+    # a GENEROUS member lease: in-rung reaping is lease-only, so a
+    # kill -9 victim's member file survives the rung and the survivors'
+    # probes deterministically exercise the dead-owner fallback (the
+    # parent's convergence check reaps by pid liveness afterwards)
+    C.FLEET_FAST_MEMBER_LEASE_MS: 60_000,
+    C.FLEET_FAST_GOSSIP_MS: 50,
 }
 
 
@@ -213,6 +220,24 @@ def worker_main(spec_path: str) -> int:
                 with open(spec["serving_marker"], "w", encoding="utf-8") as fh:
                     fh.write("1")
     wall = time.perf_counter() - t_start
+    probes = probe_mismatches = 0
+    if spec.get("fastpath_phase"):
+        # phase 2 (after the measured window — wall_s/qps are phase-1
+        # numbers): the parent refreshes the index between done1 and
+        # go2, so every live worker witnesses >=1 pushed fanout event;
+        # then each worker serves one probe per OTHER member, chosen so
+        # its digest rendezvous-routes to that member — a live target is
+        # a deterministic spool-free handoff, a kill -9'd target is a
+        # deterministic dead-owner fallback, and every probe answer is
+        # differentially checked against the unindexed truth
+        with open(spec["done1"], "w", encoding="utf-8") as fh:
+            fh.write("1")
+        deadline2 = time.monotonic() + 60.0
+        while not os.path.exists(spec["go2"]):
+            if time.monotonic() >= deadline2:
+                return 4
+            time.sleep(0.01)
+        probes, probe_mismatches = _run_probes(session, fe, spec["src"])
     stats = fe.stats()
     fe.close()
     obs_report = None
@@ -243,6 +268,8 @@ def worker_main(spec_path: str) -> int:
         "p99_ms": lat_ms[min(len(lat_ms) - 1, (len(lat_ms) * 99) // 100)]
         if lat_ms
         else 0.0,
+        "probes": probes,
+        "probe_mismatches": probe_mismatches,
         "stats": stats,
     }
     tmp = spec["out"] + ".tmp"
@@ -250,6 +277,56 @@ def worker_main(spec_path: str) -> int:
         json.dump(out, fh)
     os.replace(tmp, spec["out"])
     return 0
+
+
+def _run_probes(session, fe, src: str) -> Tuple[int, int]:
+    """Serve one digest-targeted probe per OTHER fast-plane member.
+
+    For each peer in the member directory this worker searches candidate
+    predicates until it finds one whose (plan, snapshot) digest
+    rendezvous-routes to that peer, then serves it and differentially
+    checks the answer against the unindexed truth. Returns
+    ``(probes, mismatches)``; a worker without a live router (fast plane
+    disabled or degraded) probes nothing."""
+    from hyperspace_tpu.serve import router as fleet_router
+
+    router = getattr(fe, "_router", None)
+    if router is None:
+        return 0, 0
+    members = fleet_router.read_members(fleet_router.members_dir(session.conf))
+    targets = [o for o in members if o != router.owner]
+    if not targets:
+        return 0, 0
+    pin = fe._pin()
+    if not pin:
+        return 0, 0
+    df0 = session.read.parquet(src)
+    probes = mismatches = 0
+    for target in targets:
+        probe = None
+        # the probe predicate space is disjoint from the phase-1
+        # schedule by shape (the extra always-true v bound), so probe
+        # digests never collide with already-cached phase-1 results
+        for kk in range(200):
+            df = df0.filter((df0["k"] == kk) & (df0["v"] > -2000))
+            digest = fe._plan_digest(df.logical_plan, pin)
+            if (
+                digest is not None
+                and fleet_router.rendezvous_owner(members.keys(), digest)
+                == target
+            ):
+                probe = df
+                break
+        if probe is None:
+            continue
+        got = fe.serve(probe)
+        session.disable_hyperspace()
+        want = probe.collect()
+        session.enable_hyperspace()
+        probes += 1
+        if _digest(got) != _digest(want):
+            mismatches += 1
+    return probes, mismatches
 
 
 # ---------------------------------------------------------------------------
@@ -290,6 +367,7 @@ def run_fleet(
     conf: Optional[dict] = None,
     timeout_s: float = 180.0,
     reuse_lake: Optional[Tuple[str, str]] = None,
+    fastpath_phase: bool = False,
 ) -> Dict[str, object]:
     """Run one fleet rung: N worker processes serving the same schedule
     against one lake from a barrier start (optionally ``kill -9`` one
@@ -322,6 +400,10 @@ def run_fleet(
             "out": os.path.join(root, f"out.{i}.json"),
             "conf": conf or {},
         }
+        if fastpath_phase:
+            spec["fastpath_phase"] = True
+            spec["done1"] = os.path.join(root, f"done1.{i}")
+            spec["go2"] = os.path.join(root, "go2")
         if kill_one and i == 0:
             # the victim serves an effectively-endless schedule; the
             # parent SIGKILLs it as soon as its first serve lands
@@ -348,6 +430,47 @@ def run_fleet(
                 time.sleep(0.005)
             killed_pid = procs[0].pid
             os.kill(killed_pid, signal.SIGKILL)
+        if fastpath_phase:
+            # the survivors are parked at the phase-2 barrier; refresh
+            # the index NOW (its fanout push is every live worker's
+            # pushed-event witness), then release them into the probes
+            for i, spec in enumerate(specs):
+                if kill_one and i == 0:
+                    continue
+                while not os.path.exists(spec["done1"]):
+                    if time.monotonic() >= deadline:
+                        raise TimeoutError(
+                            "fleet worker never finished phase 1"
+                        )
+                    _reap_early_exit(
+                        [p for j, p in enumerate(procs) if not (kill_one and j == 0)]
+                    )
+                    time.sleep(0.02)
+            from hyperspace_tpu.hyperspace import Hyperspace
+
+            # the refresh needs actual changes (an unchanged source is a
+            # no-op action, which publishes nothing): append one small
+            # delta file, then fan the incremental refresh out
+            delta_id = uuid.uuid4().hex[:8]
+            rng = np.random.default_rng(int(delta_id, 16) % (1 << 31))
+            pq.write_table(
+                pa.table(
+                    {
+                        "k": pa.array(rng.integers(0, 200, 200), pa.int64()),
+                        "v": pa.array(
+                            rng.integers(-1000, 1000, 200), pa.int64()
+                        ),
+                    }
+                ),
+                # unique per rung: a reused lake must present the NEXT
+                # rung's refresh with fresh changes too (an unchanged
+                # source is a no-op, and no-ops publish nothing)
+                os.path.join(src, f"part-phase2-{delta_id}.parquet"),
+            )
+            refresher = _make_session(src, index_root, fleet=True, conf=conf)
+            Hyperspace(refresher).refresh_index(INDEX_NAME, "incremental")
+            with open(os.path.join(root, "go2"), "w", encoding="utf-8") as fh:
+                fh.write("1")
         for i, p in enumerate(procs):
             if kill_one and i == 0:
                 p.wait()
@@ -389,13 +512,16 @@ def run_fleet(
     spool_hits = fleet_merged.get("spool_hits", 0)
     claims_won = fleet_merged.get("claims_won", 0)
     bus_events = fleet_merged.get("bus_events", 0)
+    probes = sum(r.get("probes", 0) for r in results)
+    probe_mismatches = sum(r.get("probe_mismatches", 0) for r in results)
     leaked = _converge_pins(index_root, lease_ms=lease_ms)
+    leaked_fast = _converge_fast_members(index_root)
     return {
         "processes": n_procs,
         "workers_reporting": len(results),
         "killed": bool(kill_one),
         "queries": total_served,
-        "wrong_answers": wrong,
+        "wrong_answers": wrong + probe_mismatches,
         "qps": round(total_served / max_wall, 1) if max_wall > 0 else 0.0,
         "p50_ms": round(
             float(np.median([r["p50_ms"] for r in results])), 2
@@ -408,7 +534,22 @@ def run_fleet(
         "cross_process_dedup": spool_hits,
         "claims_won": claims_won,
         "bus_events": bus_events,
+        # fast data plane (merged across workers; fast_frontends is the
+        # count of workers whose fast plane came up)
+        "fast_frontends": fleet_merged.get("fast_frontends", 0),
+        "fast_push_received": fleet_merged.get("fast_push_received", 0),
+        "fast_handoffs": fleet_merged.get("fast_handoffs", 0),
+        "fast_fallbacks": fleet_merged.get("fast_fallbacks", 0),
+        "fast_result_hits": fleet_merged.get("fast_result_hits", 0),
+        "fast_dedup_joins": fleet_merged.get("fast_dedup_joins", 0),
+        "fast_wait_ms_total": fleet_merged.get("fast_wait_ms_total", 0.0),
+        "fast_waits": fleet_merged.get("fast_waits", 0),
+        "poll_wait_ms_total": fleet_merged.get("poll_wait_ms_total", 0.0),
+        "poll_waits": fleet_merged.get("poll_waits", 0),
+        "probes": probes,
+        "probe_mismatches": probe_mismatches,
         "leaked_pin_files": leaked,
+        "leaked_fast_members": leaked_fast,
         "worker_obs": [r.get("obs") for r in results if r.get("obs")],
     }
 
@@ -445,6 +586,23 @@ def _converge_pins(index_root: str, lease_ms: Optional[int] = None) -> int:
             leaked += sum(
                 1 for f in os.listdir(pins_dir) if f.endswith(".json")
             )
+    return leaked
+
+
+def _converge_fast_members(index_root: str) -> int:
+    """After the rung, reap every member whose PROCESS is gone (kill -9
+    victims leave lease-valid member files — the generous harness lease
+    is deliberate, see ``WORKER_CONF``) and count member or socket files
+    that survive the reap: the fast plane's leak witness."""
+    from hyperspace_tpu.serve import router as fleet_router
+
+    mdir = os.path.join(index_root, C.HYPERSPACE_FLEET_DIR, "members")
+    _reaped, leftovers = fleet_router.reap_members(mdir, force_dead=True)
+    leaked = len(leftovers)
+    try:
+        leaked += sum(1 for f in os.listdir(mdir) if f.endswith(".json"))
+    except OSError:
+        pass
     return leaked
 
 

@@ -178,24 +178,45 @@ assert ch["serves_verified"] >= 1, ch
 print("bench_smoke: chaos recovery ok:", ch, file=sys.stderr)
 # the multi-process fleet (serve/fleet.py, docs/fleet-serve.md): N real
 # frontend processes over one lake — every rung must report ZERO wrong
-# answers, ZERO leaked pin files and a POSITIVE cross-process dedup
-# count (identical plans at N processes single-flighted to one
-# execution), and the chaos rung must have kill -9ed a frontend
-# mid-serve with the survivors still bit-identical
+# answers, ZERO leaked pin files, ZERO leaked fast-plane member/socket
+# files and a POSITIVE dedup count on SOME plane (claim/spool wins,
+# owner-routed handoffs, or fast result-cache hits), and the chaos rung
+# must have kill -9ed a frontend mid-serve with the survivors still
+# bit-identical
 fl = d["fleet_ladder"]
 assert fl, "fleet ladder rows missing"
 for r in fl:
     assert r["wrong_answers"] == 0, r
     assert r["leaked_pin_files"] == 0, r
-    assert r["cross_process_dedup"] > 0, r
+    assert r["leaked_fast_members"] == 0, r
+    dedup = (r["cross_process_dedup"] + r["fast_handoffs"]
+             + r["fast_result_hits"])
+    assert dedup > 0, r
     assert r["qps"] > 0 and r["workers_reporting"] == r["processes"], r
+# the fast data plane gates (ISSUE 20): the 2-proc rung must witness
+# >=1 PUSHED fanout event (the parent phase-2 refresh arriving over
+# the socket, not the pollMs scan) and >=1 spool-free owner-routed
+# result handoff; every routed probe differentially verified
+r2 = next((r for r in fl if r["processes"] == 2), fl[0])
+assert r2["fast_frontends"] == r2["processes"], r2
+assert r2["fast_push_received"] >= 1, r2
+assert r2["fast_handoffs"] >= 1, r2
+assert r2["probe_mismatches"] == 0, r2
 fc = d["fleet_chaos"]
 assert fc["killed"], fc
 assert fc["workers_reporting"] == fc["processes"] - 1, fc
 assert fc["wrong_answers"] == 0 and fc["leaked_pin_files"] == 0, fc
+# fast -> durable degradation witnessed with zero wrong answers: the
+# surviving probes at the dead owner paid one failed connect and fell
+# back to the claim/spool plane bit-identically
+assert fc["fast_fallbacks"] >= 1, fc
+assert fc["leaked_fast_members"] == 0, fc
 print("bench_smoke: fleet ok:",
-      [(r["processes"], r["qps"], r["cross_process_dedup"]) for r in fl],
-      "chaos:", (fc["processes"], fc["workers_reporting"]), file=sys.stderr)
+      [(r["processes"], r["qps"],
+        r["cross_process_dedup"] + r["fast_handoffs"]) for r in fl],
+      "fast: push recv", r2["fast_push_received"],
+      "handoffs", r2["fast_handoffs"],
+      "chaos fallbacks", fc["fast_fallbacks"], file=sys.stderr)
 print("bench_smoke: serve concurrency ok:",
       {c: (sc[c]["p50_ms"], sc[c]["p99_ms"], sc[c]["qps"]) for c in sc},
       file=sys.stderr)

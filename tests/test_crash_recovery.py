@@ -1091,12 +1091,22 @@ class TestCrossProcessPins:
         entries = s.index_manager.get_indexes([States.ACTIVE])
         token = recovery.register_pins(entries, durable=True, lease_ms=60)
         pins_dir = os.path.join(log_mgr.index_path, C.HYPERSPACE_PINS_DIR)
-        name = os.listdir(pins_dir)[0]
+
+        def pin_names():
+            # a listdir can race the heartbeat's fsync-before-replace
+            # and see its transient .tmp_* file; only published pin
+            # files are the contract
+            return [
+                n for n in os.listdir(pins_dir)
+                if not n.startswith(".tmp_")
+            ]
+
+        name = pin_names()[0]
         # several lease periods later the file is still unexpired: the
         # heartbeat has been renewing it
         time.sleep(0.25)
         assert recovery.durable_pinned_files(log_mgr.index_path)
-        assert os.listdir(pins_dir) == [name]
+        assert pin_names() == [name]
         recovery.release_pins(token)
 
     def test_torn_pin_file_is_reaped(self, env):

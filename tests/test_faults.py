@@ -1,9 +1,9 @@
 """Fault-injection harness + the serve fault matrix.
 
 The contract (ISSUE 8 / docs/serve-server.md): for each injection point
-(parquet read, kernel dispatch, log read, cache insert) × {transient,
-persistent}, a serve through the frontend either RETRIES to a
-bit-identical result or DEGRADES to a path with identical output —
+(parquet read, kernel dispatch, log read, cache insert, fastbus send) ×
+{transient, persistent}, a serve through the frontend either RETRIES to
+a bit-identical result or DEGRADES to a path with identical output —
 never a wrong answer, never a hung query. Every leg also asserts its
 point actually fired (``faults.stats()``), so a refactor that silently
 bypasses an injection seam fails here, not in production.
@@ -220,8 +220,9 @@ class TestFaultMatrix:
 
     def test_every_point_fired_in_this_module(self, served):
         # matrix completeness backstop: arm everything transiently, run
-        # one query per shape, and require all four points to have fired
-        # at least once in THIS test (budget sized for one serve each)
+        # one query per shape (plus one fast-plane push for the fleet
+        # seam), and require ALL points to have fired at least once in
+        # THIS test (budget sized for one serve each)
         s, fe, q_point, q_agg, base = served
         s.conf.set(C.SERVE_CACHE_ENABLED, True)
         try:
@@ -231,11 +232,152 @@ class TestFaultMatrix:
             faults.set_fault("kernel_dispatch", "transient:1")
             faults.set_fault("log_read", "transient:1")
             faults.set_fault("cache_insert", "transient:1")
+            faults.set_fault("fastbus_send", "transient:1")
             _assert_bit_identical(fe.serve(q_agg()), base["agg"])
             _assert_bit_identical(fe.serve(q_point()), base["point"])
+            from hyperspace_tpu.serve import fastbus
+
+            with pytest.raises(InjectedFault):
+                fastbus.push("/nonexistent.sock", {"type": "event"})
             fired = faults.stats()
             for point in faults.POINTS:
                 assert fired.get(point, 0) >= 1, (point, fired)
         finally:
             s.conf.set(C.SERVE_CACHE_ENABLED, False)
             s.clear_serve_cache()
+
+
+# ---------------------------------------------------------------------------
+# The fleet fast plane's send seam (serve/fastbus.py)
+# ---------------------------------------------------------------------------
+
+
+class TestFastbusSend:
+    """``fastbus_send`` × {transient, persistent}: an armed fault models
+    a dead/unreachable peer socket at the fast data plane's send seam.
+    The contract is pure degradation — pushes fall back to durable-poll
+    delivery, routed requests fall back to the claim/spool single-flight
+    — with bit-identical answers and zero raised errors on the serve
+    path (``docs/fleet-serve.md``)."""
+
+    def test_fault_raises_typed_oserror_at_the_seam(self, tmp_path):
+        from hyperspace_tpu.serve import fastbus
+
+        faults.set_fault("fastbus_send", "transient:1")
+        with pytest.raises(InjectedFault):
+            fastbus.push("/nonexistent.sock", {"type": "event"})
+        assert faults.stats()["fastbus_send"] == 1
+        # recovered: the next failed send is a plain dead-socket False,
+        # not an injected raise
+        assert not fastbus.push(str(tmp_path / "no.sock"), {"type": "e"})
+
+    def test_push_fanout_degrades_without_raising(self, tmp_path):
+        # router-level contract: an armed send fault never escapes
+        # push_event_to_members — the durable poll is the retransmit
+        import json as _json
+
+        from hyperspace_tpu.serve import fastbus, router
+
+        mdir = str(tmp_path / "members")
+        os.makedirs(mdir)
+        srv = fastbus.FastBusServer(lambda h, b: None)
+        try:
+            with open(os.path.join(mdir, "aa.json"), "w") as f:
+                _json.dump(
+                    {
+                        "owner": "aa",
+                        "pid": os.getpid(),
+                        "sock": srv.path,
+                        "expiresAtMs": int(__import__("time").time() * 1000)
+                        + 60_000,
+                    },
+                    f,
+                )
+            members = router.read_members(mdir)
+            faults.set_fault("fastbus_send", "persistent")
+            delivered = 0
+            for doc in members.values():
+                try:
+                    if fastbus.push(doc["sock"], {"type": "event"}):
+                        delivered += 1
+                except OSError:
+                    continue  # the documented degrade: poll delivers
+            assert delivered == 0
+            assert faults.stats()["fastbus_send"] >= 1
+            faults.set_fault("fastbus_send", "off")
+            assert fastbus.push(srv.path, {"type": "event"})
+        finally:
+            srv.stop()
+
+    @pytest.mark.parametrize("spec", ["transient:1", "persistent"])
+    def test_routed_request_falls_back_bit_identical(
+        self, spec, tmp_path, session_factory
+    ):
+        # end-to-end: two FleetFrontends over one lake; a query owned by
+        # the PEER hits the armed send seam, falls back to the durable
+        # claim/spool plane, and answers bit-identically
+        from hyperspace_tpu.serve.router import rendezvous_owner
+        from hyperspace_tpu.session import HyperspaceSession
+
+        d = tmp_path / "flk"
+        d.mkdir()
+        rng = np.random.default_rng(9)
+        pq.write_table(
+            pa.table(
+                {
+                    "k": pa.array(rng.integers(0, 50, 3000), pa.int64()),
+                    "v": pa.array(rng.integers(-99, 99, 3000), pa.int64()),
+                }
+            ),
+            str(d / "p0.parquet"),
+        )
+        idx = str(tmp_path / "flk_idx")
+
+        def mk():
+            s = HyperspaceSession()
+            s.conf.set(C.INDEX_SYSTEM_PATH, idx)
+            s.conf.set(C.INDEX_NUM_BUCKETS, 2)
+            s.conf.set(C.FLEET_ENABLED, True)
+            # park the gossip cadence: a maintenance-thread push must not
+            # consume the transient fault budget before the probe does
+            s.conf.set(C.FLEET_FAST_GOSSIP_MS, 60_000)
+            s.enable_hyperspace()
+            return s
+
+        s1 = mk()
+        hs = Hyperspace(s1)
+        df = s1.read.parquet(str(d))
+        hs.create_index(df, CoveringIndexConfig("flkidx", ["k"], ["v"]))
+        s2 = mk()
+        fe1, fe2 = s1.serve_frontend, s2.serve_frontend
+        try:
+            members = fe1._router.members(refresh=True)
+            pin = fe1._pin()
+            probe = None
+            for kk in range(200):
+                q = s1.read.parquet(str(d))
+                q = q.filter((q["k"] == kk % 50) & (q["v"] > -1000 - kk))
+                dig = fe1._plan_digest(q.logical_plan, pin)
+                if rendezvous_owner(members.keys(), dig) == fe2._router.owner:
+                    probe = q
+                    break
+            assert probe is not None
+            faults.set_fault("fastbus_send", spec)
+            got = fe1.serve(probe)
+            faults.set_fault("fastbus_send", "off")
+            s1.disable_hyperspace()
+            want = probe.collect()
+            s1.enable_hyperspace()
+            got = got.sort_by([(c, "ascending") for c in got.column_names])
+            want = want.sort_by(
+                [(c, "ascending") for c in want.column_names]
+            )
+            assert got.equals(want)
+            st = fe1.stats()["fleet"]
+            assert st["fast_fallbacks"] >= 1, st
+            assert faults.stats()["fastbus_send"] >= 1
+            assert fe1.stats()["failed"] == 0
+        finally:
+            faults.set_fault("fastbus_send", "off")
+            fe1.close()
+            fe2.close()

@@ -588,6 +588,10 @@ class TestFleetTraceLinkage:
             s.conf.set(C.INDEX_SYSTEM_PATH, index_root)
             s.conf.set(C.INDEX_NUM_BUCKETS, 4)
             s.conf.set(C.FLEET_ENABLED, True)
+            # this test witnesses the DURABLE claim/spool trace linkage;
+            # the fast plane would turn the second serve into a routed
+            # owner handoff and elect nobody
+            s.conf.set(C.FLEET_FAST_ENABLED, False)
             s.conf.set(C.OBS_ENABLED, True)
             s.enable_hyperspace()
             return s
@@ -640,7 +644,13 @@ class TestFleetTraceLinkage:
             n_procs=2,
             iters=3,
             rows=12_000,
-            conf={C.OBS_ENABLED: True, C.OBS_TRACE_RETAIN: 4096},
+            conf={
+                C.OBS_ENABLED: True,
+                C.OBS_TRACE_RETAIN: 4096,
+                # durable-plane linkage under test: force the claim/
+                # spool election path, not routed owner handoffs
+                C.FLEET_FAST_ENABLED: False,
+            },
         )
         assert out["wrong_answers"] == 0
         assert out["cross_process_dedup"] > 0
